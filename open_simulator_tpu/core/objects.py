@@ -1,0 +1,429 @@
+"""K8s-lite object model: the host-side representation of cluster state.
+
+This replaces the reference's reliance on `k8s.io/api/core/v1` typed objects and
+the fake clientset object store (`/root/reference/pkg/simulator/simulator.go:103`,
+`vendor/k8s.io/client-go/kubernetes/fake`). We keep lightweight dataclasses with
+only the scheduling-relevant fields, plus the original decoded dict in `raw` so
+reports and round-tripping stay faithful.
+
+All resource amounts are canonicalized at parse time:
+  cpu            -> millicores (int)
+  memory, ephemeral-storage, hugepages-*  -> bytes (int)
+  pods and extended resources (counts)    -> plain int
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+import math
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.quantity import parse_quantity
+
+# Canonical resource names (mirrors corev1.ResourceName constants).
+CPU = "cpu"
+MEMORY = "memory"
+PODS = "pods"
+EPHEMERAL_STORAGE = "ephemeral-storage"
+
+# simon annotation/label names (parity: /root/reference/pkg/type/const.go:12-43)
+ANNO_WORKLOAD_KIND = "simon/workload-kind"
+ANNO_WORKLOAD_NAME = "simon/workload-name"
+ANNO_WORKLOAD_NAMESPACE = "simon/workload-namespace"
+ANNO_NODE_LOCAL_STORAGE = "simon/node-local-storage"
+ANNO_POD_LOCAL_STORAGE = "simon/pod-local-storage"
+ANNO_NODE_GPU_SHARE = "simon/node-gpu-share"
+ANNO_POD_PROVISIONER = "simon/pod-provisioner"
+LABEL_NEW_NODE = "simon/new-node"
+LABEL_APP_NAME = "simon/app-name"
+
+# open-gpu-share annotation keys (parity: pkg/type/open-gpu-share/utils/const.go:4-8)
+ANNO_GPU_MEM_POD = "alibabacloud.com/gpu-mem"
+ANNO_GPU_INDEX = "alibabacloud.com/gpu-index"
+ANNO_GPU_COUNT_NODE = "alibabacloud.com/gpu-count"
+ANNO_GPU_MODEL_NODE = "alibabacloud.com/gpu-card-model"
+RESOURCE_GPU_COUNT = "alibabacloud.com/gpu-count"
+
+DEFAULT_SCHEDULER = "default-scheduler"
+
+
+def _canon_resources(res: Optional[dict], round_up: bool) -> Dict[str, int]:
+    """Canonicalize a resource map. round_up for requests (conservative: a pod
+    never claims less than it asked), down for node allocatable."""
+    out: Dict[str, int] = {}
+    if not res:
+        return out
+    rounder = math.ceil if round_up else math.floor
+    for name, val in res.items():
+        q = parse_quantity(val)
+        if name == CPU:
+            q *= 1000
+        out[str(name)] = int(rounder(q))
+    return out
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    owner_kind: str = ""
+    owner_name: str = ""
+
+    @staticmethod
+    def from_dict(d: Optional[dict]) -> "ObjectMeta":
+        d = d or {}
+        owner_kind = owner_name = ""
+        owners = d.get("ownerReferences") or []
+        if owners:
+            owner_kind = owners[0].get("kind", "")
+            owner_name = owners[0].get("name", "")
+        return ObjectMeta(
+            name=d.get("name", "") or d.get("generateName", ""),
+            namespace=d.get("namespace") or "default",
+            labels=dict(d.get("labels") or {}),
+            annotations={k: str(v) for k, v in (d.get("annotations") or {}).items()},
+            owner_kind=owner_kind,
+            owner_name=owner_name,
+        )
+
+
+@dataclass
+class Toleration:
+    key: str = ""          # empty key + Exists tolerates everything
+    operator: str = "Equal"  # Equal | Exists
+    value: str = ""
+    effect: str = ""       # empty matches all effects
+
+    @staticmethod
+    def from_dict(d: dict) -> "Toleration":
+        # An empty operator means Equal (vendored toleration.go ToleratesTaint).
+        return Toleration(
+            key=d.get("key", "") or "",
+            operator=d.get("operator") or "Equal",
+            value=str(d.get("value", "") or ""),
+            effect=d.get("effect", "") or "",
+        )
+
+
+@dataclass
+class Taint:
+    key: str
+    value: str = ""
+    effect: str = "NoSchedule"  # NoSchedule | PreferNoSchedule | NoExecute
+
+    @staticmethod
+    def from_dict(d: dict) -> "Taint":
+        return Taint(
+            key=d.get("key", ""),
+            value=str(d.get("value", "") or ""),
+            effect=d.get("effect", "NoSchedule"),
+        )
+
+
+@dataclass
+class LabelSelectorRequirement:
+    key: str
+    operator: str  # In | NotIn | Exists | DoesNotExist | Gt | Lt
+    values: List[str] = field(default_factory=list)
+
+
+@dataclass
+class LabelSelector:
+    """metav1.LabelSelector: matchLabels AND matchExpressions."""
+    match_labels: Dict[str, str] = field(default_factory=dict)
+    match_expressions: List[LabelSelectorRequirement] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: Optional[dict]) -> Optional["LabelSelector"]:
+        if d is None:
+            return None
+        exprs = [
+            LabelSelectorRequirement(
+                key=e.get("key", ""),
+                operator=e.get("operator", "In"),
+                values=[str(v) for v in (e.get("values") or [])],
+            )
+            for e in (d.get("matchExpressions") or [])
+        ]
+        return LabelSelector(
+            match_labels={k: str(v) for k, v in (d.get("matchLabels") or {}).items()},
+            match_expressions=exprs,
+        )
+
+    def key(self) -> Tuple:
+        """Hashable identity used to dedupe selectors during tensorization."""
+        return (
+            tuple(sorted(self.match_labels.items())),
+            tuple((e.key, e.operator, tuple(e.values)) for e in self.match_expressions),
+        )
+
+
+@dataclass
+class NodeSelectorTerm:
+    """One term: AND of requirements over labels (and fields, which we fold in)."""
+    match_expressions: List[LabelSelectorRequirement] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: dict) -> "NodeSelectorTerm":
+        exprs = []
+        for part in ("matchExpressions", "matchFields"):
+            for e in d.get(part) or []:
+                key = e.get("key", "")
+                if part == "matchFields" and key == "metadata.name":
+                    key = "kubernetes.io/hostname"  # field selector on name ~ hostname label
+                exprs.append(
+                    LabelSelectorRequirement(
+                        key=key,
+                        operator=e.get("operator", "In"),
+                        values=[str(v) for v in (e.get("values") or [])],
+                    )
+                )
+        return NodeSelectorTerm(match_expressions=exprs)
+
+
+@dataclass
+class PreferredSchedulingTerm:
+    weight: int
+    preference: NodeSelectorTerm
+
+
+@dataclass
+class PodAffinityTerm:
+    selector: Optional[LabelSelector]
+    topology_key: str
+    namespaces: List[str] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: dict) -> "PodAffinityTerm":
+        return PodAffinityTerm(
+            selector=LabelSelector.from_dict(d.get("labelSelector")),
+            topology_key=d.get("topologyKey", ""),
+            namespaces=list(d.get("namespaces") or []),
+        )
+
+
+@dataclass
+class WeightedPodAffinityTerm:
+    weight: int
+    term: PodAffinityTerm
+
+
+@dataclass
+class Affinity:
+    # node affinity
+    node_required: List[NodeSelectorTerm] = field(default_factory=list)   # OR of terms
+    node_preferred: List[PreferredSchedulingTerm] = field(default_factory=list)
+    # pod (anti) affinity
+    pod_required: List[PodAffinityTerm] = field(default_factory=list)
+    pod_preferred: List[WeightedPodAffinityTerm] = field(default_factory=list)
+    anti_required: List[PodAffinityTerm] = field(default_factory=list)
+    anti_preferred: List[WeightedPodAffinityTerm] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: Optional[dict]) -> "Affinity":
+        a = Affinity()
+        if not d:
+            return a
+        na = d.get("nodeAffinity") or {}
+        req = na.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
+        a.node_required = [
+            NodeSelectorTerm.from_dict(t) for t in (req.get("nodeSelectorTerms") or [])
+        ]
+        a.node_preferred = [
+            PreferredSchedulingTerm(
+                weight=int(t.get("weight", 1)),
+                preference=NodeSelectorTerm.from_dict(t.get("preference") or {}),
+            )
+            for t in (na.get("preferredDuringSchedulingIgnoredDuringExecution") or [])
+        ]
+        for src, req_dst, pref_dst in (
+            ("podAffinity", "pod_required", "pod_preferred"),
+            ("podAntiAffinity", "anti_required", "anti_preferred"),
+        ):
+            pa = d.get(src) or {}
+            setattr(
+                a,
+                req_dst,
+                [
+                    PodAffinityTerm.from_dict(t)
+                    for t in (pa.get("requiredDuringSchedulingIgnoredDuringExecution") or [])
+                ],
+            )
+            setattr(
+                a,
+                pref_dst,
+                [
+                    WeightedPodAffinityTerm(
+                        weight=int(t.get("weight", 1)),
+                        term=PodAffinityTerm.from_dict(t.get("podAffinityTerm") or {}),
+                    )
+                    for t in (pa.get("preferredDuringSchedulingIgnoredDuringExecution") or [])
+                ],
+            )
+        return a
+
+    def empty(self) -> bool:
+        return not (
+            self.node_required
+            or self.node_preferred
+            or self.pod_required
+            or self.pod_preferred
+            or self.anti_required
+            or self.anti_preferred
+        )
+
+
+@dataclass
+class TopologySpreadConstraint:
+    max_skew: int
+    topology_key: str
+    when_unsatisfiable: str  # DoNotSchedule | ScheduleAnyway
+    selector: Optional[LabelSelector]
+
+    @staticmethod
+    def from_dict(d: dict) -> "TopologySpreadConstraint":
+        return TopologySpreadConstraint(
+            max_skew=int(d.get("maxSkew", 1)),
+            topology_key=d.get("topologyKey", ""),
+            when_unsatisfiable=d.get("whenUnsatisfiable", "DoNotSchedule"),
+            selector=LabelSelector.from_dict(d.get("labelSelector")),
+        )
+
+
+def pod_requests_from_spec(spec: dict) -> Dict[str, int]:
+    """Effective pod resource requests.
+
+    max(sum(app containers), max(init containers)) + overhead — the formula from
+    kubectl's resourcehelper.PodRequestsAndLimits used by the reference at
+    `pkg/simulator/plugin/simon.go:46` and `pkg/algo/greed.go:55`.
+    """
+    total: Dict[str, int] = {}
+    for c in spec.get("containers") or []:
+        for name, v in _canon_resources((c.get("resources") or {}).get("requests"), True).items():
+            total[name] = total.get(name, 0) + v
+    for c in spec.get("initContainers") or []:
+        for name, v in _canon_resources((c.get("resources") or {}).get("requests"), True).items():
+            if v > total.get(name, 0):
+                total[name] = v
+    for name, v in _canon_resources(spec.get("overhead"), True).items():
+        total[name] = total.get(name, 0) + v
+    return total
+
+
+def pod_limits_from_spec(spec: dict) -> Dict[str, int]:
+    total: Dict[str, int] = {}
+    for c in spec.get("containers") or []:
+        for name, v in _canon_resources((c.get("resources") or {}).get("limits"), True).items():
+            total[name] = total.get(name, 0) + v
+    for c in spec.get("initContainers") or []:
+        for name, v in _canon_resources((c.get("resources") or {}).get("limits"), True).items():
+            if v > total.get(name, 0):
+                total[name] = v
+    return total
+
+
+@dataclass
+class Pod:
+    meta: ObjectMeta
+    requests: Dict[str, int] = field(default_factory=dict)
+    limits: Dict[str, int] = field(default_factory=dict)
+    node_name: str = ""
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    affinity: Affinity = field(default_factory=Affinity)
+    tolerations: List[Toleration] = field(default_factory=list)
+    spread_constraints: List[TopologySpreadConstraint] = field(default_factory=list)
+    scheduler_name: str = DEFAULT_SCHEDULER
+    priority: int = 0
+    phase: str = "Pending"
+    host_ports: List[Tuple[str, int]] = field(default_factory=list)  # (protocol, port)
+    pvc_names: List[str] = field(default_factory=list)
+    raw: dict = field(default_factory=dict)
+
+    @staticmethod
+    def from_dict(d: dict) -> "Pod":
+        meta = ObjectMeta.from_dict(d.get("metadata"))
+        spec = d.get("spec") or {}
+        status = d.get("status") or {}
+        # NodePorts filter parity: app containers only (vendored node_ports.go:64
+        # iterates pod.Spec.Containers, not initContainers).
+        host_ports: List[Tuple[str, int]] = []
+        host_network = bool(spec.get("hostNetwork"))
+        for c in spec.get("containers") or []:
+            for p in c.get("ports") or []:
+                hp = p.get("hostPort", 0)
+                cp = p.get("containerPort", 0)
+                port = hp or (cp if host_network else 0)
+                if port:
+                    host_ports.append((p.get("protocol", "TCP"), int(port)))
+        pvcs = [
+            v["persistentVolumeClaim"]["claimName"]
+            for v in (spec.get("volumes") or [])
+            if isinstance(v, dict) and v.get("persistentVolumeClaim")
+        ]
+        return Pod(
+            meta=meta,
+            requests=pod_requests_from_spec(spec),
+            limits=pod_limits_from_spec(spec),
+            node_name=spec.get("nodeName", "") or "",
+            node_selector={k: str(v) for k, v in (spec.get("nodeSelector") or {}).items()},
+            affinity=Affinity.from_dict(spec.get("affinity")),
+            tolerations=[Toleration.from_dict(t) for t in (spec.get("tolerations") or [])],
+            spread_constraints=[
+                TopologySpreadConstraint.from_dict(t)
+                for t in (spec.get("topologySpreadConstraints") or [])
+            ],
+            scheduler_name=spec.get("schedulerName") or DEFAULT_SCHEDULER,
+            priority=int(spec.get("priority") or 0),
+            phase=status.get("phase", "Pending"),
+            host_ports=host_ports,
+            pvc_names=pvcs,
+            raw=d,
+        )
+
+    @property
+    def key(self) -> str:
+        return f"{self.meta.namespace}/{self.meta.name}"
+
+    def gpu_mem_request(self) -> int:
+        """Per-GPU memory request in GiB units (open-gpu-share annotation)."""
+        v = self.meta.annotations.get(ANNO_GPU_MEM_POD)
+        try:
+            return int(v) if v is not None else 0
+        except ValueError:
+            return 0
+
+    def gpu_count_request(self) -> int:
+        return self.requests.get(RESOURCE_GPU_COUNT, 0)
+
+
+@dataclass
+class Node:
+    meta: ObjectMeta
+    allocatable: Dict[str, int] = field(default_factory=dict)
+    capacity: Dict[str, int] = field(default_factory=dict)
+    taints: List[Taint] = field(default_factory=list)
+    unschedulable: bool = False
+    raw: dict = field(default_factory=dict)
+
+    @staticmethod
+    def from_dict(d: dict) -> "Node":
+        meta = ObjectMeta.from_dict(d.get("metadata"))
+        spec = d.get("spec") or {}
+        status = d.get("status") or {}
+        node = Node(
+            meta=meta,
+            allocatable=_canon_resources(status.get("allocatable"), False),
+            capacity=_canon_resources(status.get("capacity"), False),
+            taints=[Taint.from_dict(t) for t in (spec.get("taints") or [])],
+            unschedulable=bool(spec.get("unschedulable")),
+            raw=d,
+        )
+        # Ensure the hostname label exists (kubelet guarantees it in practice).
+        node.meta.labels.setdefault("kubernetes.io/hostname", meta.name)
+        return node
+
+    @property
+    def name(self) -> str:
+        return self.meta.name

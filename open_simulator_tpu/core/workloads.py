@@ -1,0 +1,281 @@
+"""Workload → Pod expansion (pod synthesis).
+
+Parity targets in the reference:
+  - Deployment→ReplicaSet→Pods    /root/reference/pkg/utils/utils.go:132-171
+  - Job / CronJob                 utils.go:173-217
+  - StatefulSet (+ volumeClaimTemplates → local-storage annotation) utils.go:219-292
+  - DaemonSet (per-node eligibility via daemon-controller Predicates) utils.go:325-366
+  - MakeValidPod normalization    utils.go:378-463
+  - pod name = "<owner>-<rand10>" (STS renamed "<name>-<ordinal>") utils.go:311-313
+
+Randomized suffixes are generated from a seeded RNG so simulations are
+deterministic (the reference uses k8s rand.String(10); determinism there is
+irrelevant because names never affect placement).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import random
+import string
+from typing import Dict, List, Optional
+
+from .objects import (
+    ANNO_POD_LOCAL_STORAGE,
+    ANNO_WORKLOAD_KIND,
+    ANNO_WORKLOAD_NAME,
+    ANNO_WORKLOAD_NAMESPACE,
+    Node,
+    Pod,
+)
+from .matcher import daemonset_should_run
+from ..utils.quantity import parse_int
+
+# Workload kind strings (parity: pkg/type/const.go workload kinds)
+DEPLOYMENT = "Deployment"
+REPLICASET = "ReplicaSet"
+STATEFULSET = "StatefulSet"
+DAEMONSET = "DaemonSet"
+JOB = "Job"
+CRONJOB = "CronJob"
+POD = "Pod"
+
+WORKLOAD_KINDS = {DEPLOYMENT, REPLICASET, STATEFULSET, DAEMONSET, JOB, CRONJOB, POD}
+
+# open-local / yoda storage-class name table (parity: pkg/utils/const.go:3-17)
+LVM_SC_NAMES = {"open-local-lvm", "yoda-lvm-default"}
+SSD_SC_NAMES = {
+    "open-local-device-ssd",
+    "open-local-mountpoint-ssd",
+    "yoda-mountpoint-ssd",
+    "yoda-device-ssd",
+}
+HDD_SC_NAMES = {
+    "open-local-device-hdd",
+    "open-local-mountpoint-hdd",
+    "yoda-mountpoint-hdd",
+    "yoda-device-hdd",
+}
+
+_rng = random.Random(0x51B0)
+
+
+def reset_name_rng(seed: int = 0x51B0) -> None:
+    _rng.seed(seed)
+
+
+def _rand_suffix(n: int = 10) -> str:
+    alphabet = string.ascii_lowercase + string.digits
+    return "".join(_rng.choice(alphabet) for _ in range(n))
+
+
+def _pod_dict_from_template(owner: dict, kind: str, template: dict) -> dict:
+    meta = owner.get("metadata") or {}
+    tmeta = template.get("metadata") or {}
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": f"{meta.get('name', 'pod')}-{_rand_suffix()}",
+            "generateName": meta.get("name", ""),
+            "namespace": meta.get("namespace") or "default",
+            "labels": copy.deepcopy(tmeta.get("labels") or {}),
+            "annotations": copy.deepcopy(tmeta.get("annotations") or {}),
+            "ownerReferences": [
+                {
+                    "kind": kind,
+                    "name": meta.get("name", ""),
+                    "controller": True,
+                }
+            ],
+        },
+        "spec": copy.deepcopy(template.get("spec") or {}),
+    }
+
+
+def make_valid_pod_dict(pod: dict) -> dict:
+    """MakeValidPod normalization (utils.go:378-463): defaults, strip env/
+    volumeMounts/probes/pull-secrets, PVC volumes → hostPath, empty status."""
+    pod = copy.deepcopy(pod)
+    meta = pod.setdefault("metadata", {})
+    meta.setdefault("labels", {})
+    meta.setdefault("annotations", {})
+    if not meta.get("namespace"):
+        meta["namespace"] = "default"
+    spec = pod.setdefault("spec", {})
+    spec.setdefault("dnsPolicy", "ClusterFirst")
+    spec.setdefault("restartPolicy", "Always")
+    spec.setdefault("schedulerName", "default-scheduler")
+    spec.pop("imagePullSecrets", None)
+    for section in ("initContainers", "containers"):
+        for c in spec.get(section) or []:
+            c.setdefault("terminationMessagePolicy", "FallbackToLogsOnError")
+            c.setdefault("imagePullPolicy", "IfNotPresent")
+            sc = c.get("securityContext")
+            if sc and "privileged" in sc:
+                sc["privileged"] = False
+            c.pop("volumeMounts", None)
+            c.pop("env", None)
+            if section == "containers":
+                c.pop("livenessProbe", None)
+                c.pop("readinessProbe", None)
+                c.pop("startupProbe", None)
+    for v in spec.get("volumes") or []:
+        if isinstance(v, dict) and v.get("persistentVolumeClaim"):
+            v.pop("persistentVolumeClaim")
+            v["hostPath"] = {"path": "/tmp"}
+    pod["status"] = {}
+    return pod
+
+
+def _add_workload_info(pod: dict, kind: str, name: str, namespace: str) -> dict:
+    anns = pod["metadata"].setdefault("annotations", {})
+    anns[ANNO_WORKLOAD_KIND] = kind
+    anns[ANNO_WORKLOAD_NAME] = name
+    anns[ANNO_WORKLOAD_NAMESPACE] = namespace or "default"
+    return pod
+
+
+def _storage_annotation(volume_claim_templates: List[dict]) -> Optional[str]:
+    """volumeClaimTemplates → simon/pod-local-storage annotation (utils.go:246-292)."""
+    volumes = []
+    for pvc in volume_claim_templates or []:
+        spec = pvc.get("spec") or {}
+        sc = spec.get("storageClassName")
+        size = parse_int(
+            ((spec.get("resources") or {}).get("requests") or {}).get("storage", 0)
+        )
+        if sc in LVM_SC_NAMES:
+            kind = "LVM"
+        elif sc in SSD_SC_NAMES:
+            kind = "SSD"
+        elif sc in HDD_SC_NAMES:
+            kind = "HDD"
+        else:
+            continue  # unsupported storage class — reference logs an error
+        volumes.append({"size": size, "kind": kind, "storageClassName": sc})
+    if not volumes:
+        return None
+    return json.dumps({"volumes": volumes})
+
+
+def pods_from_workload(obj: dict, nodes: Optional[List[Node]] = None) -> List[Pod]:
+    """Expand one decoded workload object into scheduling-ready Pods."""
+    kind = obj.get("kind", "")
+    meta = obj.get("metadata") or {}
+    name = meta.get("name", "")
+    namespace = meta.get("namespace") or "default"
+    spec = obj.get("spec") or {}
+    out: List[dict] = []
+
+    if kind == POD:
+        p = make_valid_pod_dict(obj)
+        out.append(p)
+    elif kind in (DEPLOYMENT, REPLICASET):
+        replicas = spec.get("replicas", 1)
+        template = spec.get("template") or {}
+        for _ in range(int(replicas if replicas is not None else 1)):
+            p = make_valid_pod_dict(_pod_dict_from_template(obj, REPLICASET, template))
+            # Deployment pods are annotated as ReplicaSet-owned (utils.go:132-135)
+            out.append(_add_workload_info(p, REPLICASET, name, namespace))
+    elif kind == STATEFULSET:
+        replicas = spec.get("replicas", 1)
+        template = spec.get("template") or {}
+        storage_ann = _storage_annotation(spec.get("volumeClaimTemplates") or [])
+        for ordinal in range(int(replicas if replicas is not None else 1)):
+            p = make_valid_pod_dict(_pod_dict_from_template(obj, STATEFULSET, template))
+            p["metadata"]["name"] = f"{name}-{ordinal}"
+            _add_workload_info(p, STATEFULSET, name, namespace)
+            if storage_ann:
+                p["metadata"]["annotations"][ANNO_POD_LOCAL_STORAGE] = storage_ann
+            out.append(p)
+    elif kind == JOB:
+        completions = spec.get("completions", 1)
+        template = spec.get("template") or {}
+        for _ in range(int(completions if completions is not None else 1)):
+            p = make_valid_pod_dict(_pod_dict_from_template(obj, JOB, template))
+            out.append(_add_workload_info(p, JOB, name, namespace))
+    elif kind == CRONJOB:
+        job_spec = (spec.get("jobTemplate") or {}).get("spec") or {}
+        completions = job_spec.get("completions", 1)
+        template = job_spec.get("template") or {}
+        for _ in range(int(completions if completions is not None else 1)):
+            p = make_valid_pod_dict(_pod_dict_from_template(obj, JOB, template))
+            p["metadata"]["annotations"].setdefault(
+                "cronjob.kubernetes.io/instantiate", "manual"
+            )
+            out.append(_add_workload_info(p, JOB, name, namespace))
+    elif kind == DAEMONSET:
+        return daemonset_pods(obj, nodes or [])
+    else:
+        raise ValueError(f"unsupported workload kind: {kind}")
+    return [Pod.from_dict(p) for p in out]
+
+
+def daemonset_pods(ds: dict, nodes: List[Node]) -> List[Pod]:
+    """One pod per eligible node, pinned via required node affinity on the
+    hostname — parity with NewDaemonPod/SetDaemonSetPodNodeNameByNodeAffinity
+    (utils.go:338-366, 466-493)."""
+    meta = ds.get("metadata") or {}
+    name = meta.get("name", "")
+    namespace = meta.get("namespace") or "default"
+    template = (ds.get("spec") or {}).get("template") or {}
+    pods: List[Pod] = []
+    for node in nodes:
+        d = _pod_dict_from_template(ds, DAEMONSET, template)
+        spec = d["spec"]
+        pin = {"key": "metadata.name", "operator": "In", "values": [node.name]}
+        aff = spec.setdefault("affinity", {})
+        node_aff = aff.setdefault("nodeAffinity", {})
+        req = node_aff.setdefault("requiredDuringSchedulingIgnoredDuringExecution", {})
+        terms = req.get("nodeSelectorTerms")
+        if terms:
+            # AND the node-name pin into every existing term, keeping the
+            # template's matchExpressions (utils.go:806-813).
+            for t in terms:
+                t["matchFields"] = [pin]
+        else:
+            req["nodeSelectorTerms"] = [{"matchFields": [pin]}]
+        p = make_valid_pod_dict(d)
+        pod = Pod.from_dict(_add_workload_info(p, DAEMONSET, name, namespace))
+        if daemonset_should_run(pod, node):
+            pods.append(pod)
+    return pods
+
+
+def expected_pod_counts(objs: List[dict], nodes: List[Node]) -> Dict[str, int]:
+    """Workload-conservation oracle: how many pods should each workload yield.
+
+    Mirrors the checkResult oracle in the reference's core_test.go:364-591.
+    An explicit replicas/completions of 0 counts as 0 (only a missing/None
+    field defaults to 1, matching pods_from_workload).
+    """
+
+    def _count(value) -> int:
+        return 1 if value is None else int(value)
+
+    counts: Dict[str, int] = {}
+    # Preserve the shared name RNG: the oracle must not perturb the names of
+    # pods synthesized after it runs.
+    rng_state = _rng.getstate()
+    try:
+        for obj in objs:
+            kind = obj.get("kind", "")
+            meta = obj.get("metadata") or {}
+            key = f"{kind}/{meta.get('namespace') or 'default'}/{meta.get('name')}"
+            spec = obj.get("spec") or {}
+            if kind == POD:
+                counts[key] = counts.get(key, 0) + 1
+            elif kind in (DEPLOYMENT, REPLICASET, STATEFULSET):
+                counts[key] = _count(spec.get("replicas", None))
+            elif kind == JOB:
+                counts[key] = _count(spec.get("completions", None))
+            elif kind == CRONJOB:
+                job_spec = (spec.get("jobTemplate") or {}).get("spec") or {}
+                counts[key] = _count(job_spec.get("completions", None))
+            elif kind == DAEMONSET:
+                counts[key] = len(daemonset_pods(obj, nodes))
+    finally:
+        _rng.setstate(rng_state)
+    return counts

@@ -1,0 +1,190 @@
+import yaml
+
+from open_simulator_tpu.core.objects import Node, Pod
+from open_simulator_tpu.core.matcher import (
+    daemonset_should_run,
+    fits_resources,
+    match_label_selector,
+    match_node_affinity,
+    untolerated_taint,
+)
+from open_simulator_tpu.core.objects import LabelSelector
+from open_simulator_tpu.core.workloads import pods_from_workload, reset_name_rng
+
+NODE_YAML = """
+apiVersion: v1
+kind: Node
+metadata:
+  name: master-1
+  labels:
+    kubernetes.io/hostname: master-1
+    node-role.kubernetes.io/master: ""
+spec:
+  taints:
+  - effect: NoSchedule
+    key: node-role.kubernetes.io/master
+status:
+  allocatable:
+    cpu: "8"
+    memory: 16Gi
+    pods: "110"
+"""
+
+POD_YAML = """
+apiVersion: v1
+kind: Pod
+metadata:
+  name: busy
+  namespace: simple
+spec:
+  tolerations:
+  - key: node-role.kubernetes.io/master
+    operator: Exists
+    effect: NoSchedule
+  containers:
+  - name: c
+    image: busybox
+    resources:
+      requests:
+        cpu: 1500m
+        memory: 1Gi
+"""
+
+DEPLOY_YAML = """
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: web
+  namespace: app
+spec:
+  replicas: 3
+  template:
+    metadata:
+      labels: {app: web}
+    spec:
+      containers:
+      - name: c
+        image: nginx
+        resources:
+          requests: {cpu: 500m, memory: 512Mi}
+"""
+
+DS_YAML = """
+apiVersion: apps/v1
+kind: DaemonSet
+metadata:
+  name: agent
+  namespace: kube-system
+spec:
+  template:
+    metadata:
+      labels: {app: agent}
+    spec:
+      affinity:
+        nodeAffinity:
+          requiredDuringSchedulingIgnoredDuringExecution:
+            nodeSelectorTerms:
+            - matchExpressions:
+              - key: node-role.kubernetes.io/master
+                operator: DoesNotExist
+      containers:
+      - name: c
+        image: agent
+"""
+
+
+def test_node_parse():
+    node = Node.from_dict(yaml.safe_load(NODE_YAML))
+    assert node.name == "master-1"
+    assert node.allocatable["cpu"] == 8000
+    assert node.allocatable["memory"] == 16 * 1024**3
+    assert node.allocatable["pods"] == 110
+    assert node.taints[0].key == "node-role.kubernetes.io/master"
+
+
+def test_pod_parse_and_predicates():
+    node = Node.from_dict(yaml.safe_load(NODE_YAML))
+    pod = Pod.from_dict(yaml.safe_load(POD_YAML))
+    assert pod.requests == {"cpu": 1500, "memory": 1024**3}
+    assert untolerated_taint(pod.tolerations, node) is None
+    pod2 = Pod.from_dict({"metadata": {"name": "x"}, "spec": {"containers": []}})
+    assert untolerated_taint(pod2.tolerations, node) is not None
+    assert match_node_affinity(pod, node)
+    assert fits_resources(pod, {"cpu": 1500, "memory": 1024**3}) == []
+    assert fits_resources(pod, {"cpu": 1499, "memory": 1024**3}) == ["cpu"]
+
+
+def test_label_selector():
+    sel = LabelSelector.from_dict(
+        {
+            "matchLabels": {"app": "web"},
+            "matchExpressions": [{"key": "tier", "operator": "In", "values": ["fe", "be"]}],
+        }
+    )
+    assert match_label_selector(sel, {"app": "web", "tier": "fe"})
+    assert not match_label_selector(sel, {"app": "web"})
+    assert not match_label_selector(None, {"app": "web"})
+    empty = LabelSelector.from_dict({})
+    assert match_label_selector(empty, {"anything": "goes"})
+
+
+def test_deployment_expansion():
+    reset_name_rng()
+    pods = pods_from_workload(yaml.safe_load(DEPLOY_YAML))
+    assert len(pods) == 3
+    for p in pods:
+        assert p.meta.namespace == "app"
+        assert p.meta.labels == {"app": "web"}
+        assert p.requests == {"cpu": 500, "memory": 512 * 1024**2}
+        assert p.meta.annotations["simon/workload-kind"] == "ReplicaSet"
+        assert p.meta.annotations["simon/workload-name"] == "web"
+        assert p.meta.name.startswith("web-")
+    assert len({p.meta.name for p in pods}) == 3
+
+
+def test_statefulset_names_and_storage():
+    sts = yaml.safe_load(DEPLOY_YAML)
+    sts["kind"] = "StatefulSet"
+    sts["metadata"]["name"] = "db"
+    sts["spec"]["volumeClaimTemplates"] = [
+        {
+            "metadata": {"name": "data"},
+            "spec": {
+                "storageClassName": "open-local-lvm",
+                "resources": {"requests": {"storage": "10Gi"}},
+            },
+        }
+    ]
+    pods = pods_from_workload(sts)
+    assert [p.meta.name for p in pods] == ["db-0", "db-1", "db-2"]
+    assert "simon/pod-local-storage" in pods[0].meta.annotations
+
+
+def test_daemonset_eligibility():
+    master = Node.from_dict(yaml.safe_load(NODE_YAML))
+    worker_dict = yaml.safe_load(NODE_YAML)
+    worker_dict["metadata"] = {"name": "worker-1", "labels": {"kubernetes.io/hostname": "worker-1"}}
+    worker_dict["spec"] = {}
+    worker = Node.from_dict(worker_dict)
+    pods = pods_from_workload(yaml.safe_load(DS_YAML), nodes=[master, worker])
+    # master excluded by DoesNotExist on the master role label
+    assert len(pods) == 1
+    assert daemonset_should_run(pods[0], worker)
+    assert not daemonset_should_run(pods[0], master)
+
+
+def test_job_and_cronjob():
+    job = {
+        "kind": "Job",
+        "metadata": {"name": "pi"},
+        "spec": {"completions": 2, "template": {"spec": {"containers": []}}},
+    }
+    assert len(pods_from_workload(job)) == 2
+    cron = {
+        "kind": "CronJob",
+        "metadata": {"name": "tick"},
+        "spec": {"jobTemplate": {"spec": {"template": {"spec": {"containers": []}}}}},
+    }
+    pods = pods_from_workload(cron)
+    assert len(pods) == 1
+    assert pods[0].meta.annotations["simon/workload-kind"] == "Job"

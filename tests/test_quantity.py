@@ -1,0 +1,60 @@
+from fractions import Fraction
+
+import pytest
+
+from open_simulator_tpu.utils.quantity import (
+    format_bytes,
+    format_milli,
+    parse_int,
+    parse_milli,
+    parse_quantity,
+)
+
+
+@pytest.mark.parametrize(
+    "text,expected",
+    [
+        ("1", 1),
+        ("100m", Fraction(1, 10)),
+        ("1500m", Fraction(3, 2)),
+        ("2", 2),
+        ("1Gi", 1024**3),
+        ("16Gi", 16 * 1024**3),
+        ("512Mi", 512 * 1024**2),
+        ("61255492Ki", 61255492 * 1024),
+        ("1k", 1000),
+        ("1M", 10**6),
+        ("1e3", 1000),
+        ("1.5e2", 150),
+        ("0.5", Fraction(1, 2)),
+        (".5", Fraction(1, 2)),
+        ("-1", -1),
+        ("107374182400", 107374182400),
+    ],
+)
+def test_parse_quantity(text, expected):
+    assert parse_quantity(text) == expected
+
+
+def test_parse_helpers():
+    assert parse_milli("1500m") == 1500
+    assert parse_milli("2") == 2000
+    assert parse_milli("0.1") == 100
+    assert parse_int("1Gi") == 1024**3
+    assert parse_int(110) == 110
+    assert parse_int("110") == 110
+
+
+def test_parse_invalid():
+    with pytest.raises(ValueError):
+        parse_quantity("abc")
+    with pytest.raises(ValueError):
+        parse_quantity("1Qi")
+
+
+def test_format():
+    assert format_milli(1500) == "1500m"
+    assert format_milli(2000) == "2"
+    assert format_bytes(1024**3) == "1Gi"
+    assert format_bytes(512 * 1024**2) == "512Mi"
+    assert format_bytes(1000) == "1000"
