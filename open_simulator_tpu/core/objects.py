@@ -39,6 +39,7 @@ LABEL_APP_NAME = "simon/app-name"
 
 # open-gpu-share annotation keys (parity: pkg/type/open-gpu-share/utils/const.go:4-8)
 ANNO_GPU_MEM_POD = "alibabacloud.com/gpu-mem"
+ANNO_GPU_COUNT_POD = "alibabacloud.com/gpu-count"
 ANNO_GPU_INDEX = "alibabacloud.com/gpu-index"
 ANNO_GPU_COUNT_NODE = "alibabacloud.com/gpu-count"
 ANNO_GPU_MODEL_NODE = "alibabacloud.com/gpu-card-model"
@@ -395,7 +396,16 @@ class Pod:
             return 0
 
     def gpu_count_request(self) -> int:
-        return self.requests.get(RESOURCE_GPU_COUNT, 0)
+        """GPU count from the open-gpu-share annotation (reference reads
+        alibabacloud.com/gpu-count from pod annotations, utils/pod.go:69-79);
+        defaults to 1 when only gpu-mem is set."""
+        v = self.meta.annotations.get(ANNO_GPU_COUNT_POD)
+        try:
+            if v is not None:
+                return int(v)
+        except ValueError:
+            pass
+        return 1 if self.gpu_mem_request() > 0 else 0
 
 
 @dataclass

@@ -1,0 +1,244 @@
+"""Simulation engine: the TPU-native equivalent of pkg/simulator.
+
+Parity map (reference → here):
+  - `Simulate(cluster, apps, opts...)` (`pkg/simulator/core.go:67`) → `simulate()`
+  - `Simulator.RunCluster` / `ScheduleApp` (`pkg/simulator/simulator.go:219-275`)
+    → `_schedule_batch_host` over the cluster's pending pods, then each app's
+    pods in order.
+  - the per-pod create→watch→bind handshake (`simulator.go:309-348,449-468`)
+    → a single `lax.scan` on device; placements come back as one vector.
+  - `Close()` teardown dance (`simulator.go:350-363`) → nothing: the engine is
+    a plain object with no background goroutines to defuse (SURVEY §3.4's
+    leak-by-design is structurally impossible here).
+
+Pod ordering parity: ScheduleApp sorts by AffinityQueue then TolerationQueue
+(`simulator.go:238-241`, `pkg/algo/{affinity,toleration}.go`): pods with
+tolerations first, then pods with node selectors.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.objects import (
+    ANNO_NODE_LOCAL_STORAGE,
+    DEFAULT_SCHEDULER,
+    Node,
+    Pod,
+)
+from ..core.workloads import WORKLOAD_KINDS, pods_from_workload
+from ..ops.encode import (
+    Encoder,
+    aggregate_usage,
+    encode_nodes,
+    encode_pods,
+    initial_selector_counts,
+)
+from ..ops.kernels import (
+    FILTER_MESSAGES,
+    NUM_FILTERS,
+    DEFAULT_WEIGHTS,
+    schedule_batch,
+    weights_array,
+)
+from ..ops.state import (
+    align_sel_counts,
+    carry_from_table,
+    node_static_from_table,
+    pod_rows_from_batch,
+)
+
+
+@dataclass
+class ClusterResource:
+    """Initial cluster state (parity: simulator.ResourceTypes, core.go:33-45)."""
+    nodes: List[Node] = field(default_factory=list)
+    pods: List[Pod] = field(default_factory=list)
+    daemonsets: List[dict] = field(default_factory=list)
+    others: Dict[str, List[dict]] = field(default_factory=dict)
+
+    @staticmethod
+    def from_objects(objs: Sequence[dict]) -> "ClusterResource":
+        cluster = ClusterResource()
+        for o in objs:
+            kind = o.get("kind", "")
+            if kind == "Node":
+                cluster.nodes.append(Node.from_dict(o))
+            elif kind == "Pod":
+                cluster.pods.append(Pod.from_dict(o))
+            elif kind == "DaemonSet":
+                cluster.daemonsets.append(o)
+            else:
+                cluster.others.setdefault(kind, []).append(o)
+        return cluster
+
+    def attach_local_storage(self, storage_by_name: Dict[str, str]) -> None:
+        """Match node-local-storage JSON specs to nodes by file stem
+        (parity: MatchAndSetLocalStorageAnnotationOnNode, utils.go:385-401)."""
+        for node in self.nodes:
+            info = storage_by_name.get(node.name)
+            if info is not None:
+                node.meta.annotations[ANNO_NODE_LOCAL_STORAGE] = info
+
+
+@dataclass
+class AppResource:
+    """One app: ordered list of decoded workload objects (core.go:47-51)."""
+    name: str
+    objects: List[dict] = field(default_factory=list)
+
+
+@dataclass
+class UnscheduledPod:
+    pod: Pod
+    reason: str
+
+
+@dataclass
+class NodeStatus:
+    node: Node
+    pods: List[Pod] = field(default_factory=list)
+
+
+@dataclass
+class SimulateResult:
+    unscheduled: List[UnscheduledPod] = field(default_factory=list)
+    node_status: List[NodeStatus] = field(default_factory=list)
+
+    def pods_on(self, node_name: str) -> List[Pod]:
+        for st in self.node_status:
+            if st.node.name == node_name:
+                return st.pods
+        return []
+
+
+def _order_pods(pods: List[Pod]) -> List[Pod]:
+    """AffinityQueue then TolerationQueue, as stable sorts (algo.go parity)."""
+    pods = sorted(pods, key=lambda p: not p.node_selector)
+    pods = sorted(pods, key=lambda p: not p.tolerations)
+    return pods
+
+
+def _reason_string(n_nodes: int, counts: np.ndarray) -> str:
+    """Rebuild the reference's unschedulable diagnostics, e.g.
+    '0/4 nodes are available: 3 node(s) had taint..., 1 Insufficient resources.'
+    """
+    parts = [
+        f"{int(counts[f])} {FILTER_MESSAGES[f]}"
+        for f in range(NUM_FILTERS)
+        if counts[f] > 0
+    ]
+    detail = ", ".join(parts) if parts else "no nodes in cluster"
+    return f"0/{n_nodes} nodes are available: {detail}."
+
+
+class Simulator:
+    """Owns the device-resident cluster state for one simulation run."""
+
+    def __init__(
+        self,
+        cluster: ClusterResource,
+        weights: Optional[dict] = None,
+    ) -> None:
+        self.cluster = cluster
+        self.weights = weights_array(weights or DEFAULT_WEIGHTS)
+        self.enc = Encoder(topology_keys=("kubernetes.io/hostname",))
+        self._bound: List[Tuple[Pod, str]] = []   # (pod, node name)
+        self._pending_cluster: List[Pod] = []
+        for pod in cluster.pods:
+            if pod.node_name:
+                self._bound.append((pod, pod.node_name))
+            elif pod.scheduler_name == DEFAULT_SCHEDULER:
+                self._pending_cluster.append(pod)
+        # Cluster daemonsets expand against the final node list (core.go:85-96).
+        for ds in cluster.daemonsets:
+            self._pending_cluster.extend(pods_from_workload(ds, nodes=cluster.nodes))
+        self._table = None
+        self._ns = None
+        self._carry = None
+
+    # -- device state ------------------------------------------------------
+    def _build_device_state(self, all_pods: Sequence[Pod]) -> None:
+        """Register every pod that will ever be scheduled, then ship the node
+        table once. Registering everything up front keeps the resource axis
+        and selector ids stable across app batches."""
+        self.enc.register_pods(list(all_pods))
+        for pod, _ in self._bound:
+            self.enc.register_pods([pod])
+        self._table = encode_nodes(
+            self.enc, self.cluster.nodes, existing_usage=aggregate_usage(self._bound)
+        )
+        self._ns = node_static_from_table(self.enc, self._table)
+        sel = initial_selector_counts(self.enc, self._table, self._bound)
+        self._carry = carry_from_table(self._table, sel)
+
+    def _schedule_batch_host(self, pods: List[Pod]) -> List[UnscheduledPod]:
+        """Encode one batch, scan it on device, decode placements."""
+        if not pods:
+            return []
+        batch = encode_pods(self.enc, pods)
+        self._carry = align_sel_counts(self._carry, len(self.enc.selectors))
+        rows = pod_rows_from_batch(batch)
+        self._carry, placed, reasons = schedule_batch(
+            self._ns, self._carry, rows, self.weights
+        )
+        placed_np = np.asarray(placed)
+        reasons_np = np.asarray(reasons)
+        failed: List[UnscheduledPod] = []
+        n_nodes = len(self.cluster.nodes)
+        for i, pod in enumerate(pods):
+            ni = int(placed_np[i])
+            if ni >= 0:
+                pod.node_name = self._table.names[ni]
+                pod.phase = "Running"
+                self._bound.append((pod, pod.node_name))
+            else:
+                failed.append(
+                    UnscheduledPod(pod, _reason_string(n_nodes, reasons_np[i]))
+                )
+        return failed
+
+    # -- public ------------------------------------------------------------
+    def run(self, apps: Sequence[AppResource]) -> SimulateResult:
+        app_pods: List[List[Pod]] = []
+        for app in apps:
+            pods: List[Pod] = []
+            for obj in app.objects:
+                kind = obj.get("kind", "")
+                if kind in WORKLOAD_KINDS:
+                    pods.extend(pods_from_workload(obj, nodes=self.cluster.nodes))
+            app_pods.append(_order_pods(pods))
+
+        self._build_device_state(
+            self._pending_cluster + [p for pods in app_pods for p in pods]
+        )
+
+        result = SimulateResult()
+        # RunCluster: the cluster's own pending pods schedule first.
+        result.unscheduled.extend(
+            self._schedule_batch_host(_order_pods(self._pending_cluster))
+        )
+        # ScheduleApp: each app in configured order.
+        for pods in app_pods:
+            result.unscheduled.extend(self._schedule_batch_host(pods))
+
+        by_node: Dict[str, NodeStatus] = {
+            n.name: NodeStatus(node=n) for n in self.cluster.nodes
+        }
+        for pod, node_name in self._bound:
+            if node_name in by_node:
+                by_node[node_name].pods.append(pod)
+        result.node_status = list(by_node.values())
+        return result
+
+
+def simulate(
+    cluster: ClusterResource,
+    apps: Sequence[AppResource],
+    weights: Optional[dict] = None,
+) -> SimulateResult:
+    """One-shot simulation (parity: simulator.Simulate, core.go:67-119)."""
+    return Simulator(cluster, weights=weights).run(apps)

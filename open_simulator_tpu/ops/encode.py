@@ -1,0 +1,520 @@
+"""Tensorization: object model → structure-of-arrays device tensors.
+
+This is the L1 replacement: where the reference keeps cluster state in the fake
+clientset's ObjectTracker (`vendor/k8s.io/client-go/testing/fixture.go`), the
+TPU build keeps it as HBM-resident tensors. Strings (labels, taints, namespaces)
+are interned into integer vocabularies on the host; all per-node and per-pod
+scheduling state becomes fixed-shape arrays so the whole Filter/Score/Select
+loop stays inside one XLA computation.
+
+Shapes (N nodes, P pods, R resources, padded caps L/T/TERM/EXPR/VAL/TOL/S/K):
+  NodeTable: alloc f32[N,R], free f32[N,R], label_pair i32[N,L], label_key
+  i32[N,L], label_num f32[N,L], taint_{key,val,effect} i32[N,T], name_id i32[N],
+  unsched bool[N], avoid_pods bool[N], topo i32[N,K], valid bool[N]
+  PodBatch: req f32[P,R], selector term tensors, tolerations, preferred terms,
+  spread/affinity constraint tables, match_sel bool[P,S].
+
+Bucketed padding (`round_up`) keeps jit cache hits across add-node iterations.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..core.matcher import match_label_selector
+from ..core.objects import (
+    LabelSelector,
+    Node,
+    Pod,
+)
+
+# Resource scaling: canonical int units -> f32-safe units.
+# cpu is already milli; byte-like resources go to MiB so f32's 24-bit mantissa
+# stays exact up to 16 TiB per node.
+_BYTE_LIKE = ("memory", "ephemeral-storage", "storage", "hugepages-")
+_EFFECTS = {"NoSchedule": 1, "PreferNoSchedule": 2, "NoExecute": 3}
+
+OP_PAD, OP_IN, OP_NOT_IN, OP_EXISTS, OP_NOT_EXISTS, OP_GT, OP_LT = range(7)
+_OPS = {
+    "In": OP_IN,
+    "NotIn": OP_NOT_IN,
+    "Exists": OP_EXISTS,
+    "DoesNotExist": OP_NOT_EXISTS,
+    "Gt": OP_GT,
+    "Lt": OP_LT,
+}
+
+
+def resource_scale(name: str) -> float:
+    if any(name.startswith(b) or name == b for b in _BYTE_LIKE):
+        return float(1 << 20)
+    return 1.0
+
+
+def round_up(n: int, minimum: int = 8) -> int:
+    """Bucket a dynamic size: next power of two (>= minimum) so jit caches hit
+    across add-node iterations and varying app sizes."""
+    size = max(n, minimum, 1)
+    return 1 << (size - 1).bit_length()
+
+
+class Vocab:
+    """Host-side string interner. Id 0 is reserved for 'absent'."""
+
+    def __init__(self) -> None:
+        self._ids: Dict[str, int] = {}
+
+    def id(self, s: str) -> int:
+        i = self._ids.get(s)
+        if i is None:
+            i = len(self._ids) + 1
+            self._ids[s] = i
+        return i
+
+    def get(self, s: str) -> int:
+        return self._ids.get(s, 0)
+
+    def __len__(self) -> int:
+        return len(self._ids)
+
+
+@dataclass
+class SelectorEntry:
+    """A deduped (namespaces, LabelSelector) pair used by spread/affinity terms."""
+    namespaces: Tuple[str, ...]
+    selector: Optional[LabelSelector]
+
+    def matches(self, pod: Pod) -> bool:
+        if self.namespaces and pod.meta.namespace not in self.namespaces:
+            return False
+        return match_label_selector(self.selector, pod.meta.labels)
+
+
+class Encoder:
+    """Shared vocabularies + caps for one simulation. Nodes and pods must be
+    encoded by the same Encoder so ids line up."""
+
+    UNSCHED_TAINT_KEY = "node.kubernetes.io/unschedulable"
+
+    def __init__(self, topology_keys: Sequence[str] = ()) -> None:
+        self.keys = Vocab()        # label keys
+        self.vals = Vocab()        # label values
+        # Pre-intern ids the kernels reference as scalars, so they are stable
+        # regardless of node/pod encode order.
+        self.unsched_key_id = self.keys.id(self.UNSCHED_TAINT_KEY)
+        self.empty_val_id = self.vals.id("")
+        self.pairs = Vocab()       # "key=value"
+        self.names = Vocab()       # node names
+        self.resources: List[str] = ["cpu", "memory", "pods"]
+        self.topology_keys: List[str] = list(
+            dict.fromkeys(list(topology_keys))
+        )
+        self.selectors: List[SelectorEntry] = []
+        self._selector_ids: Dict[Tuple, int] = {}
+        self.domains = Vocab()     # "topokey=value" domain ids
+        self.domain_topo: List[int] = []  # topo-key index per domain id (1-based)
+
+    def domain_id(self, key_idx: int, key: str, value: str) -> int:
+        before = len(self.domains)
+        did = self.domains.id(f"{key}={value}")
+        if len(self.domains) > before:
+            self.domain_topo.append(key_idx)
+        return did
+
+    # -- registration -------------------------------------------------------
+    def resource_index(self, name: str) -> int:
+        if name not in self.resources:
+            self.resources.append(name)
+        return self.resources.index(name)
+
+    def topo_index(self, key: str) -> int:
+        if key not in self.topology_keys:
+            self.topology_keys.append(key)
+        return self.topology_keys.index(key)
+
+    def selector_id(self, namespaces: Sequence[str], selector: Optional[LabelSelector]) -> int:
+        key = (
+            tuple(sorted(namespaces)),
+            selector.key() if selector is not None else None,
+        )
+        sid = self._selector_ids.get(key)
+        if sid is None:
+            sid = len(self.selectors)
+            self._selector_ids[key] = sid
+            self.selectors.append(SelectorEntry(tuple(sorted(namespaces)), selector))
+        return sid
+
+    def pair_id(self, key: str, value: str) -> int:
+        self.keys.id(key)
+        self.vals.id(value)
+        return self.pairs.id(f"{key}={value}")
+
+    def register_pods(self, pods: Sequence[Pod]) -> None:
+        """Pre-register every resource name, topology key and selector used by
+        a pod batch, so caps and ids are stable before arrays are built."""
+        for pod in pods:
+            for r in pod.requests:
+                self.resource_index(r)
+            for c in pod.spread_constraints:
+                if c.topology_key:
+                    self.topo_index(c.topology_key)
+                self.selector_id((pod.meta.namespace,), c.selector)
+            aff = pod.affinity
+            for terms in (aff.pod_required, aff.anti_required):
+                for t in terms:
+                    if t.topology_key:
+                        self.topo_index(t.topology_key)
+                    self.selector_id(t.namespaces or (pod.meta.namespace,), t.selector)
+            for wt in list(aff.pod_preferred) + list(aff.anti_preferred):
+                t = wt.term
+                if t.topology_key:
+                    self.topo_index(t.topology_key)
+                self.selector_id(t.namespaces or (pod.meta.namespace,), t.selector)
+
+
+@dataclass
+class NodeTable:
+    """SoA encoding of all nodes. All arrays are numpy; the engine ships them
+    to the device once per simulation."""
+    alloc: np.ndarray       # f32[N,R] allocatable, scaled units
+    free: np.ndarray        # f32[N,R] allocatable - requested(existing pods)
+    label_pair: np.ndarray  # i32[N,L]
+    label_key: np.ndarray   # i32[N,L]
+    label_num: np.ndarray   # f32[N,L] numeric label value (nan if non-numeric)
+    taint_key: np.ndarray   # i32[N,T]
+    taint_val: np.ndarray   # i32[N,T]
+    taint_effect: np.ndarray  # i32[N,T] 0=pad
+    name_id: np.ndarray     # i32[N]
+    unsched: np.ndarray     # bool[N]
+    avoid_pods: np.ndarray  # bool[N] NodePreferAvoidPods annotation present
+    topo: np.ndarray        # i32[N,K] domain id or -1
+    valid: np.ndarray       # bool[N]
+    names: List[str] = field(default_factory=list)
+
+    @property
+    def n(self) -> int:
+        return self.alloc.shape[0]
+
+
+@dataclass
+class PodBatch:
+    """SoA encoding of a pod batch to schedule sequentially."""
+    req: np.ndarray            # f32[P,R]
+    has_req: np.ndarray        # bool[P] (simon score: empty requests => max)
+    node_name_id: np.ndarray   # i32[P] 0 = unpinned
+    # required node affinity: OR over TERM terms, AND over EXPR exprs each
+    sel_op: np.ndarray         # i32[P,TERM,EXPR]
+    sel_key: np.ndarray        # i32[P,TERM,EXPR]
+    sel_val: np.ndarray        # i32[P,TERM,EXPR,VAL] pair ids for In/NotIn
+    sel_num: np.ndarray        # f32[P,TERM,EXPR] numeric rhs for Gt/Lt
+    has_terms: np.ndarray      # bool[P] any required term present
+    # plain nodeSelector: all pairs must be present
+    ns_pair: np.ndarray        # i32[P,NS]
+    # preferred node affinity terms (flattened single-expression groups)
+    pref_weight: np.ndarray    # f32[P,PREF]
+    pref_op: np.ndarray        # i32[P,PREF,EXPR]
+    pref_key: np.ndarray       # i32[P,PREF,EXPR]
+    pref_val: np.ndarray       # i32[P,PREF,EXPR,VAL]
+    pref_num: np.ndarray       # f32[P,PREF,EXPR]
+    # tolerations
+    tol_key: np.ndarray        # i32[P,TOL] 0 = all keys
+    tol_val: np.ndarray        # i32[P,TOL]
+    tol_exists: np.ndarray     # bool[P,TOL]
+    tol_effect: np.ndarray     # i32[P,TOL] 0 = all effects
+    tol_valid: np.ndarray      # bool[P,TOL]
+    # topology spread constraints
+    spread_topo: np.ndarray    # i32[P,C] topo key index or -1
+    spread_sel: np.ndarray     # i32[P,C] selector id
+    spread_skew: np.ndarray    # f32[P,C]
+    spread_hard: np.ndarray    # bool[P,C]
+    # inter-pod (anti)affinity terms
+    aff_topo: np.ndarray       # i32[P,A] topo key index or -1
+    aff_sel: np.ndarray        # i32[P,A]
+    aff_anti: np.ndarray       # bool[P,A]
+    aff_required: np.ndarray   # bool[P,A]
+    aff_weight: np.ndarray     # f32[P,A] (preferred terms; 0 for required)
+    # membership of this pod in each deduped selector
+    match_sel: np.ndarray      # bool[P,S]
+    owned_by_rs: np.ndarray    # bool[P] controller is ReplicaSet/RC (NodePreferAvoidPods)
+    valid: np.ndarray          # bool[P]
+    keys: List[str] = field(default_factory=list)  # namespace/name per row
+
+    @property
+    def p(self) -> int:
+        return self.req.shape[0]
+
+
+def _num_or_nan(s: str) -> float:
+    try:
+        return float(int(s))
+    except ValueError:
+        return float("nan")
+
+
+def encode_nodes(
+    enc: Encoder,
+    nodes: Sequence[Node],
+    existing_usage: Optional[Dict[str, Dict[str, int]]] = None,
+    n_pad: Optional[int] = None,
+) -> NodeTable:
+    """Build the node table. existing_usage maps node name -> canonical request
+    totals of already-bound pods (subtracted into `free`)."""
+    n = len(nodes)
+    N = n_pad if n_pad is not None else round_up(n)
+    R = len(enc.resources)
+    L = round_up(max((len(nd.meta.labels) for nd in nodes), default=1), 4)
+    T = round_up(max((len(nd.taints) for nd in nodes), default=1), 2)
+    K = max(len(enc.topology_keys), 1)
+
+    alloc = np.zeros((N, R), np.float32)
+    free = np.zeros((N, R), np.float32)
+    label_pair = np.zeros((N, L), np.int32)
+    label_key = np.zeros((N, L), np.int32)
+    label_num = np.full((N, L), np.nan, np.float32)
+    taint_key = np.zeros((N, T), np.int32)
+    taint_val = np.zeros((N, T), np.int32)
+    taint_effect = np.zeros((N, T), np.int32)
+    name_id = np.zeros(N, np.int32)
+    unsched = np.zeros(N, bool)
+    avoid = np.zeros(N, bool)
+    topo = np.full((N, K), -1, np.int32)
+    valid = np.zeros(N, bool)
+
+    usage = existing_usage or {}
+    for i, nd in enumerate(nodes):
+        valid[i] = True
+        name_id[i] = enc.names.id(nd.name)
+        unsched[i] = nd.unschedulable
+        avoid[i] = "scheduler.alpha.kubernetes.io/preferAvoidPods" in nd.meta.annotations
+        for r, res in enumerate(enc.resources):
+            a = nd.allocatable.get(res, 0) / resource_scale(res)
+            alloc[i, r] = a
+            used = usage.get(nd.name, {}).get(res, 0) / resource_scale(res)
+            free[i, r] = a - used
+        for j, (k, v) in enumerate(sorted(nd.meta.labels.items())):
+            if j >= L:
+                break
+            label_key[i, j] = enc.keys.id(k)
+            label_pair[i, j] = enc.pair_id(k, v)
+            label_num[i, j] = _num_or_nan(v)
+        for j, t in enumerate(nd.taints):
+            if j >= T:
+                break
+            taint_key[i, j] = enc.keys.id(t.key)
+            taint_val[i, j] = enc.vals.id(t.value)
+            taint_effect[i, j] = _EFFECTS.get(t.effect, 0)
+        for k_idx, key in enumerate(enc.topology_keys):
+            v = nd.meta.labels.get(key)
+            if v is not None:
+                topo[i, k_idx] = enc.domain_id(k_idx, key, v)
+
+    return NodeTable(
+        alloc=alloc, free=free, label_pair=label_pair, label_key=label_key,
+        label_num=label_num, taint_key=taint_key, taint_val=taint_val,
+        taint_effect=taint_effect, name_id=name_id, unsched=unsched,
+        avoid_pods=avoid, topo=topo, valid=valid,
+        names=[nd.name for nd in nodes],
+    )
+
+
+def _encode_term_exprs(enc: Encoder, exprs, EXPR: int, VAL: int):
+    """Encode one node-selector term's expressions into fixed arrays."""
+    op = np.zeros(EXPR, np.int32)
+    key = np.zeros(EXPR, np.int32)
+    val = np.zeros((EXPR, VAL), np.int32)
+    num = np.zeros(EXPR, np.float32)
+    for e, ex in enumerate(exprs[:EXPR]):
+        op[e] = _OPS.get(ex.operator, OP_PAD)
+        key[e] = enc.keys.id(ex.key)
+        for v, value in enumerate(ex.values[:VAL]):
+            val[e, v] = enc.pair_id(ex.key, value)
+        if ex.operator in ("Gt", "Lt") and ex.values:
+            try:
+                num[e] = float(int(ex.values[0]))
+            except ValueError:
+                num[e] = float("nan")
+    return op, key, val, num
+
+
+def encode_pods(
+    enc: Encoder,
+    pods: Sequence[Pod],
+    p_pad: Optional[int] = None,
+) -> PodBatch:
+    enc.register_pods(pods)
+    p = len(pods)
+    P = p_pad if p_pad is not None else round_up(p)
+    R = len(enc.resources)
+    S = max(len(enc.selectors), 1)
+
+    def cap(f, minimum=1):
+        return max((f(pod) for pod in pods), default=minimum) or minimum
+
+    TERM = round_up(cap(lambda pd: len(pd.affinity.node_required)), 1)
+    EXPR = round_up(
+        cap(
+            lambda pd: max(
+                [len(t.match_expressions) for t in pd.affinity.node_required]
+                + [
+                    len(t.preference.match_expressions)
+                    for t in pd.affinity.node_preferred
+                ]
+                + [0]
+            )
+        ),
+        2,
+    )
+    VAL = round_up(
+        cap(
+            lambda pd: max(
+                [
+                    len(e.values)
+                    for t in pd.affinity.node_required
+                    for e in t.match_expressions
+                ]
+                + [
+                    len(e.values)
+                    for t in pd.affinity.node_preferred
+                    for e in t.preference.match_expressions
+                ]
+                + [0]
+            )
+        ),
+        2,
+    )
+    NS = round_up(cap(lambda pd: len(pd.node_selector)), 2)
+    PREF = round_up(cap(lambda pd: len(pd.affinity.node_preferred)), 1)
+    TOL = round_up(cap(lambda pd: len(pd.tolerations)), 2)
+    C = round_up(cap(lambda pd: len(pd.spread_constraints)), 1)
+    A = round_up(
+        cap(
+            lambda pd: len(pd.affinity.pod_required)
+            + len(pd.affinity.anti_required)
+            + len(pd.affinity.pod_preferred)
+            + len(pd.affinity.anti_preferred)
+        ),
+        1,
+    )
+
+    b = PodBatch(
+        req=np.zeros((P, R), np.float32),
+        has_req=np.zeros(P, bool),
+        node_name_id=np.zeros(P, np.int32),
+        sel_op=np.zeros((P, TERM, EXPR), np.int32),
+        sel_key=np.zeros((P, TERM, EXPR), np.int32),
+        sel_val=np.zeros((P, TERM, EXPR, VAL), np.int32),
+        sel_num=np.zeros((P, TERM, EXPR), np.float32),
+        has_terms=np.zeros(P, bool),
+        ns_pair=np.zeros((P, NS), np.int32),
+        pref_weight=np.zeros((P, PREF), np.float32),
+        pref_op=np.zeros((P, PREF, EXPR), np.int32),
+        pref_key=np.zeros((P, PREF, EXPR), np.int32),
+        pref_val=np.zeros((P, PREF, EXPR, VAL), np.int32),
+        pref_num=np.zeros((P, PREF, EXPR), np.float32),
+        tol_key=np.zeros((P, TOL), np.int32),
+        tol_val=np.zeros((P, TOL), np.int32),
+        tol_exists=np.zeros((P, TOL), bool),
+        tol_effect=np.zeros((P, TOL), np.int32),
+        tol_valid=np.zeros((P, TOL), bool),
+        spread_topo=np.full((P, C), -1, np.int32),
+        spread_sel=np.zeros((P, C), np.int32),
+        spread_skew=np.zeros((P, C), np.float32),
+        spread_hard=np.zeros((P, C), bool),
+        aff_topo=np.full((P, A), -1, np.int32),
+        aff_sel=np.zeros((P, A), np.int32),
+        aff_anti=np.zeros((P, A), bool),
+        aff_required=np.zeros((P, A), bool),
+        aff_weight=np.zeros((P, A), np.float32),
+        match_sel=np.zeros((P, S), bool),
+        owned_by_rs=np.zeros(P, bool),
+        valid=np.zeros(P, bool),
+        keys=[pd.key for pd in pods],
+    )
+
+    for i, pod in enumerate(pods):
+        b.valid[i] = True
+        b.has_req[i] = bool(pod.requests)
+        b.owned_by_rs[i] = pod.meta.owner_kind in ("ReplicaSet", "ReplicationController")
+        for res, q in pod.requests.items():
+            b.req[i, enc.resource_index(res)] = q / resource_scale(res)
+        b.req[i, enc.resources.index("pods")] += 1.0  # each pod occupies a slot
+        if pod.node_name:
+            b.node_name_id[i] = enc.names.id(pod.node_name)
+        for j, t in enumerate(pod.affinity.node_required[:TERM]):
+            op, key, val, num = _encode_term_exprs(enc, t.match_expressions, EXPR, VAL)
+            b.sel_op[i, j], b.sel_key[i, j], b.sel_val[i, j], b.sel_num[i, j] = op, key, val, num
+        b.has_terms[i] = bool(pod.affinity.node_required)
+        for j, (k, v) in enumerate(sorted(pod.node_selector.items())[:NS]):
+            b.ns_pair[i, j] = enc.pair_id(k, v)
+        for j, pref in enumerate(pod.affinity.node_preferred[:PREF]):
+            b.pref_weight[i, j] = float(pref.weight)
+            op, key, val, num = _encode_term_exprs(
+                enc, pref.preference.match_expressions, EXPR, VAL
+            )
+            b.pref_op[i, j], b.pref_key[i, j], b.pref_val[i, j], b.pref_num[i, j] = (
+                op, key, val, num,
+            )
+        for j, t in enumerate(pod.tolerations[:TOL]):
+            b.tol_valid[i, j] = True
+            b.tol_key[i, j] = enc.keys.id(t.key) if t.key else 0
+            b.tol_val[i, j] = enc.vals.id(t.value) if t.value else enc.vals.id("")
+            b.tol_exists[i, j] = t.operator == "Exists"
+            b.tol_effect[i, j] = _EFFECTS.get(t.effect, 0)
+        for j, c in enumerate(pod.spread_constraints[:C]):
+            b.spread_topo[i, j] = enc.topo_index(c.topology_key) if c.topology_key else -1
+            b.spread_sel[i, j] = enc.selector_id((pod.meta.namespace,), c.selector)
+            b.spread_skew[i, j] = float(c.max_skew)
+            b.spread_hard[i, j] = c.when_unsatisfiable == "DoNotSchedule"
+        terms = (
+            [(t, False, True, 0.0) for t in pod.affinity.pod_required]
+            + [(t, True, True, 0.0) for t in pod.affinity.anti_required]
+            + [(wt.term, False, False, float(wt.weight)) for wt in pod.affinity.pod_preferred]
+            + [(wt.term, True, False, float(wt.weight)) for wt in pod.affinity.anti_preferred]
+        )
+        for j, (t, anti, required, weight) in enumerate(terms[:A]):
+            b.aff_topo[i, j] = enc.topo_index(t.topology_key) if t.topology_key else -1
+            b.aff_sel[i, j] = enc.selector_id(t.namespaces or (pod.meta.namespace,), t.selector)
+            b.aff_anti[i, j] = anti
+            b.aff_required[i, j] = required
+            b.aff_weight[i, j] = weight
+        for s, entry in enumerate(enc.selectors):
+            b.match_sel[i, s] = entry.matches(pod)
+
+    return b
+
+
+def aggregate_usage(placed: Sequence[Tuple[Pod, str]]) -> Dict[str, Dict[str, int]]:
+    """Canonical per-node request totals of already-bound pods, including the
+    implicit 'pods' slot each pod occupies — feed this to encode_nodes so
+    NodeResourcesFit sees both resource and pod-count pressure."""
+    usage: Dict[str, Dict[str, int]] = {}
+    for pod, node_name in placed:
+        tot = usage.setdefault(node_name, {})
+        for res, q in pod.requests.items():
+            tot[res] = tot.get(res, 0) + q
+        tot["pods"] = tot.get("pods", 0) + 1
+    return usage
+
+
+def initial_selector_counts(
+    enc: Encoder,
+    table: NodeTable,
+    placed: Sequence[Tuple[Pod, str]],
+) -> np.ndarray:
+    """sel_counts f32[S,N]: per (selector, node) count of already-placed pods
+    matching the selector. Seeded from existing cluster pods; maintained on
+    device as the scan carry afterwards."""
+    S = max(len(enc.selectors), 1)
+    counts = np.zeros((S, table.n), np.float32)
+    node_index = {name: i for i, name in enumerate(table.names)}
+    for pod, node_name in placed:
+        ni = node_index.get(node_name)
+        if ni is None:
+            continue
+        for s, entry in enumerate(enc.selectors):
+            if entry.matches(pod):
+                counts[s, ni] += 1.0
+    return counts

@@ -1,0 +1,495 @@
+"""Device-side scheduling kernels: the pod×node Filter/Score/Select loop.
+
+This is the TPU-native replacement for the reference's per-pod scheduleOne
+cycle (`vendor/k8s.io/kubernetes/pkg/scheduler/core/generic_scheduler.go:131-175`,
+16-goroutine fan-out at `internal/parallelize/parallelism.go:57`):
+
+  - every Filter plugin is a vectorized boolean mask over all N nodes at once;
+  - every Score plugin is an f32[N] kernel + its own normalize, combined by the
+    profile's weights (`algorithmprovider/registry.go:71-148` defaults + the
+    Simon plugin from `pkg/simulator/plugin/simon.go:45-101`);
+  - host selection is a deterministic masked argmax (lowest node index wins
+    ties — the reference's selectHost randomizes, we pin for reproducibility);
+  - the sequential one-pod-at-a-time commit semantics of kube-scheduler are
+    preserved by a `lax.scan` whose carry is the mutable cluster state
+    (free resources + per-selector placement counts), so an entire pod batch
+    schedules in ONE device computation with no host round-trips.
+
+Everything here is shape-static and jit-safe; dynamic control flow is
+expressed with lax.scan / jnp.where only.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .encode import (
+    OP_GT,
+    OP_IN,
+    OP_LT,
+    OP_NOT_EXISTS,
+    OP_NOT_IN,
+    OP_PAD,
+    OP_EXISTS,
+)
+
+# Filter indices — order mirrors the kube filter plugin order so the
+# first-failure reason attribution matches the reference's diagnostics.
+F_UNSCHEDULABLE = 0
+F_NODE_NAME = 1
+F_TAINT = 2
+F_NODE_AFFINITY = 3
+F_RESOURCES = 4
+F_SPREAD = 5
+F_POD_AFFINITY = 6
+NUM_FILTERS = 7
+
+FILTER_MESSAGES = (
+    "node(s) were unschedulable",
+    "node(s) didn't match the requested node name",
+    "node(s) had taint that the pod didn't tolerate",
+    "node(s) didn't match Pod's node affinity/selector",
+    "Insufficient resources",
+    "node(s) didn't match pod topology spread constraints",
+    "node(s) didn't match pod affinity/anti-affinity rules",
+)
+
+# Score weights, matching the default v1beta1 provider weights
+# (SURVEY §2.2: registry.go:71-148) plus Simon at weight 1.
+DEFAULT_WEIGHTS = {
+    "balanced_allocation": 1.0,
+    "least_allocated": 1.0,
+    "node_affinity": 1.0,
+    "taint_toleration": 1.0,
+    "topology_spread": 2.0,
+    "inter_pod_affinity": 1.0,
+    "prefer_avoid_pods": 10000.0,
+    "simon": 1.0,
+}
+WEIGHT_ORDER = tuple(sorted(DEFAULT_WEIGHTS))
+
+
+def weights_array(weights: dict = DEFAULT_WEIGHTS) -> jnp.ndarray:
+    return jnp.array([float(weights.get(k, 0.0)) for k in WEIGHT_ORDER], jnp.float32)
+
+
+class NodeStatic(NamedTuple):
+    """Immutable per-node tensors (device resident for a whole simulation)."""
+    alloc: jnp.ndarray        # f32[N,R]
+    label_pair: jnp.ndarray   # i32[N,L]
+    label_key: jnp.ndarray    # i32[N,L]
+    label_num: jnp.ndarray    # f32[N,L]
+    taint_key: jnp.ndarray    # i32[N,T]
+    taint_val: jnp.ndarray    # i32[N,T]
+    taint_effect: jnp.ndarray  # i32[N,T]
+    name_id: jnp.ndarray      # i32[N]
+    unsched: jnp.ndarray      # bool[N]
+    avoid_pods: jnp.ndarray   # bool[N]
+    topo: jnp.ndarray         # i32[N,K] domain id or -1
+    valid: jnp.ndarray        # bool[N]
+    domain_key: jnp.ndarray   # i32[D] topo-key index per domain id (-1 pad)
+    unsched_key_id: jnp.ndarray  # i32 scalar: key id of node.kubernetes.io/unschedulable
+    empty_val_id: jnp.ndarray    # i32 scalar: value id of ""
+
+
+class Carry(NamedTuple):
+    """Mutable cluster state threaded through the scan."""
+    free: jnp.ndarray        # f32[N,R]
+    sel_counts: jnp.ndarray  # f32[S,N]
+
+
+class PodRow(NamedTuple):
+    """One pod's features (a slice of the PodBatch arrays)."""
+    req: jnp.ndarray
+    has_req: jnp.ndarray
+    node_name_id: jnp.ndarray
+    sel_op: jnp.ndarray
+    sel_key: jnp.ndarray
+    sel_val: jnp.ndarray
+    sel_num: jnp.ndarray
+    has_terms: jnp.ndarray
+    ns_pair: jnp.ndarray
+    pref_weight: jnp.ndarray
+    pref_op: jnp.ndarray
+    pref_key: jnp.ndarray
+    pref_val: jnp.ndarray
+    pref_num: jnp.ndarray
+    tol_key: jnp.ndarray
+    tol_val: jnp.ndarray
+    tol_exists: jnp.ndarray
+    tol_effect: jnp.ndarray
+    tol_valid: jnp.ndarray
+    spread_topo: jnp.ndarray
+    spread_sel: jnp.ndarray
+    spread_skew: jnp.ndarray
+    spread_hard: jnp.ndarray
+    aff_topo: jnp.ndarray
+    aff_sel: jnp.ndarray
+    aff_anti: jnp.ndarray
+    aff_required: jnp.ndarray
+    aff_weight: jnp.ndarray
+    match_sel: jnp.ndarray
+    owned_by_rs: jnp.ndarray
+    valid: jnp.ndarray
+
+
+_EPS = 1e-3  # absolute slack for f32 resource comparisons (units: milli / MiB)
+
+
+# ---------------------------------------------------------------------------
+# node-selector term matching (shared by NodeAffinity filter + score)
+# ---------------------------------------------------------------------------
+
+def _expr_matches(ns: NodeStatic, op, key, val, num):
+    """One expression vs all nodes. op/key scalar, val i32[VAL]. -> bool[N]"""
+    has_key = jnp.any((ns.label_key == key) & (key != 0), axis=1)          # [N]
+    pair_hit = jnp.any(
+        (ns.label_pair[:, :, None] == val[None, None, :]) & (val != 0)[None, None, :],
+        axis=(1, 2),
+    )                                                                       # [N]
+    key_rows = ns.label_key == key                                          # [N,L]
+    gt = jnp.any(key_rows & (ns.label_num > num), axis=1)
+    lt = jnp.any(key_rows & (ns.label_num < num), axis=1)
+    return jnp.select(
+        [op == OP_IN, op == OP_NOT_IN, op == OP_EXISTS, op == OP_NOT_EXISTS,
+         op == OP_GT, op == OP_LT],
+        [pair_hit, ~pair_hit, has_key, ~has_key, gt, lt],
+        default=jnp.ones_like(has_key),  # OP_PAD: neutral inside an AND
+    )
+
+
+def _term_matches(ns: NodeStatic, ops, keys, vals, nums):
+    """One term (AND of EXPR expressions) vs all nodes -> bool[N].
+    A term with no real expressions matches nothing (upstream semantics)."""
+    per_expr = jax.vmap(
+        lambda o, k, v, n: _expr_matches(ns, o, k, v, n),
+        in_axes=(0, 0, 0, 0),
+        out_axes=1,
+    )(ops, keys, vals, nums)                                  # [N,EXPR]
+    non_empty = jnp.any(ops != OP_PAD)
+    return jnp.all(per_expr, axis=1) & non_empty
+
+
+def node_affinity_mask(ns: NodeStatic, pod: PodRow) -> jnp.ndarray:
+    """NodeAffinity filter: plain nodeSelector AND required affinity terms
+    (OR over terms). Parity: plugins/nodeaffinity + nodeSelector matching."""
+    # nodeSelector: every listed pair must be present on the node
+    wanted = pod.ns_pair                                       # [NS]
+    present = jnp.any(
+        ns.label_pair[:, :, None] == wanted[None, None, :], axis=1
+    )                                                          # [N,NS]
+    ns_ok = jnp.all(present | (wanted == 0)[None, :], axis=1)  # [N]
+    term_hits = jax.vmap(
+        lambda o, k, v, n: _term_matches(ns, o, k, v, n),
+        in_axes=(0, 0, 0, 0),
+        out_axes=1,
+    )(pod.sel_op, pod.sel_key, pod.sel_val, pod.sel_num)       # [N,TERM]
+    terms_ok = jnp.any(term_hits, axis=1) | ~pod.has_terms
+    return ns_ok & terms_ok
+
+
+def taint_mask(ns: NodeStatic, pod: PodRow) -> jnp.ndarray:
+    """TaintToleration filter: every NoSchedule/NoExecute taint tolerated."""
+    tk, tv, te = ns.taint_key, ns.taint_val, ns.taint_effect   # [N,T]
+    # toleration axis -> [N,T,TOL]
+    eff_ok = (pod.tol_effect[None, None, :] == 0) | (pod.tol_effect[None, None, :] == te[:, :, None])
+    key_ok = (pod.tol_key[None, None, :] == 0) | (pod.tol_key[None, None, :] == tk[:, :, None])
+    val_ok = pod.tol_exists[None, None, :] | (pod.tol_val[None, None, :] == tv[:, :, None])
+    tolerated = jnp.any(
+        pod.tol_valid[None, None, :] & eff_ok & key_ok & val_ok, axis=2
+    )                                                          # [N,T]
+    hard = (te == 1) | (te == 3)                               # NoSchedule/NoExecute
+    return jnp.all(tolerated | ~hard, axis=1)
+
+
+def _domain_counts(ns: NodeStatic, counts_node: jnp.ndarray, topo_k: jnp.ndarray) -> jnp.ndarray:
+    """Scatter per-node counts into per-domain sums. counts_node f32[N],
+    topo_k i32[N] (domain id or -1) -> f32[D+1] (last slot = dropped)."""
+    D = ns.domain_key.shape[0]
+    idx = jnp.where(topo_k >= 0, topo_k, D)
+    return jnp.zeros(D + 1, jnp.float32).at[idx].add(
+        jnp.where(ns.valid, counts_node, 0.0)
+    )
+
+
+def spread_mask(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
+    """PodTopologySpread hard constraints.
+
+    skew(node) = count(domain(node)) + 1 - min over existing domains of the
+    topology key. Deviation from upstream: the global min is taken over all
+    domains of the key rather than only node-affinity-eligible ones.
+    """
+
+    def one(topo_idx, sel_idx, max_skew, hard):
+        active = (topo_idx >= 0) & hard
+        k = jnp.maximum(topo_idx, 0)
+        topo_k = ns.topo[:, k]                                  # [N]
+        counts_node = carry.sel_counts[sel_idx]                 # [N]
+        dom = _domain_counts(ns, counts_node, topo_k)           # [D+1]
+        in_key = ns.domain_key == k                             # [D]
+        min_count = jnp.min(
+            jnp.where(in_key, dom[:-1], jnp.inf)
+        )
+        min_count = jnp.where(jnp.isfinite(min_count), min_count, 0.0)
+        candidate = jnp.where(topo_k >= 0, dom[jnp.maximum(topo_k, 0)], jnp.inf)
+        ok = (candidate + 1.0 - min_count) <= max_skew + _EPS
+        ok = ok & (topo_k >= 0)
+        return jnp.where(active, ok, jnp.ones_like(ok))
+
+    per_c = jax.vmap(one, in_axes=(0, 0, 0, 0), out_axes=1)(
+        pod.spread_topo, pod.spread_sel, pod.spread_skew, pod.spread_hard
+    )                                                           # [N,C]
+    return jnp.all(per_c, axis=1)
+
+
+def pod_affinity_mask(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
+    """InterPodAffinity required terms.
+
+    affinity: candidate node's domain must already hold a matching pod — OR the
+    incoming pod matches its own selector and no match exists anywhere (the
+    upstream first-pod-of-a-group special case).
+    anti-affinity: candidate node's domain must hold none.
+    Deviation: existing pods' anti-affinity terms (symmetry check) are not yet
+    enforced — tracked for a later round.
+    """
+
+    def one(topo_idx, sel_idx, anti, required):
+        active = (topo_idx >= 0) & required
+        k = jnp.maximum(topo_idx, 0)
+        topo_k = ns.topo[:, k]
+        counts_node = carry.sel_counts[sel_idx]
+        dom = _domain_counts(ns, counts_node, topo_k)
+        cnt = jnp.where(topo_k >= 0, dom[jnp.maximum(topo_k, 0)], 0.0)   # [N]
+        total = jnp.sum(dom[:-1])
+        self_match = pod.match_sel[sel_idx]
+        aff_ok = (cnt > 0) | (self_match & (total == 0))
+        aff_ok = aff_ok & (topo_k >= 0)
+        anti_ok = cnt == 0
+        ok = jnp.where(anti, anti_ok, aff_ok)
+        return jnp.where(active, ok, jnp.ones(ns.valid.shape, bool))
+
+    per_a = jax.vmap(one, in_axes=(0, 0, 0, 0), out_axes=1)(
+        pod.aff_topo, pod.aff_sel, pod.aff_anti, pod.aff_required
+    )
+    return jnp.all(per_a, axis=1)
+
+
+def run_filters(ns: NodeStatic, carry: Carry, pod: PodRow):
+    """All filter plugins -> (mask bool[N], first_fail i32[N]).
+
+    first_fail is the index of the first failing filter per node (kube stops a
+    node's filter chain at the first failure), or NUM_FILTERS when feasible.
+    """
+    # NodeUnschedulable filter admits pods tolerating the synthetic
+    # node.kubernetes.io/unschedulable:NoSchedule taint (plugin parity);
+    # Equal with empty value tolerates it too (taint value is "").
+    unsched_tolerated = jnp.any(
+        pod.tol_valid
+        & ((pod.tol_key == 0) | (pod.tol_key == ns.unsched_key_id))
+        & (pod.tol_exists | (pod.tol_val == ns.empty_val_id))
+        & ((pod.tol_effect == 0) | (pod.tol_effect == 1)),
+    )
+    fails = jnp.stack(
+        [
+            ns.unsched & ~unsched_tolerated,
+            (pod.node_name_id != 0) & (ns.name_id != pod.node_name_id),
+            ~taint_mask(ns, pod),
+            ~node_affinity_mask(ns, pod),
+            jnp.any(pod.req[None, :] > carry.free + _EPS, axis=1),
+            ~spread_mask(ns, carry, pod),
+            ~pod_affinity_mask(ns, carry, pod),
+        ],
+        axis=1,
+    )                                                           # [N,F]
+    mask = ~jnp.any(fails, axis=1) & ns.valid
+    first_fail = jnp.where(
+        jnp.any(fails, axis=1), jnp.argmax(fails, axis=1), NUM_FILTERS
+    )
+    return mask, first_fail
+
+
+# ---------------------------------------------------------------------------
+# Score plugins
+# ---------------------------------------------------------------------------
+
+def _minmax_normalize(score: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """Simon's NormalizeScore (simon.go:76-101): min-max to 0..100; constant
+    scores collapse to 0."""
+    lo = jnp.min(jnp.where(valid, score, jnp.inf))
+    hi = jnp.max(jnp.where(valid, score, -jnp.inf))
+    rng = hi - lo
+    return jnp.where(rng > 0, (score - lo) * 100.0 / jnp.maximum(rng, 1e-9), 0.0)
+
+
+def score_least_allocated(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
+    """NodeResourcesLeastAllocated over cpu+memory (dims 0,1 by construction)."""
+    alloc = ns.alloc[:, :2]
+    free_after = carry.free[:, :2] - pod.req[None, :2]
+    frac = jnp.where(alloc > 0, free_after / jnp.maximum(alloc, 1e-9), 0.0)
+    return jnp.clip(jnp.mean(frac, axis=1), 0.0, 1.0) * 100.0
+
+
+def score_balanced(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
+    """NodeResourcesBalancedAllocation: 100 - |cpuFrac - memFrac|*100."""
+    alloc = ns.alloc[:, :2]
+    used_after = ns.alloc[:, :2] - carry.free[:, :2] + pod.req[None, :2]
+    frac = jnp.where(alloc > 0, used_after / jnp.maximum(alloc, 1e-9), 0.0)
+    frac = jnp.clip(frac, 0.0, 1.0)
+    return (1.0 - jnp.abs(frac[:, 0] - frac[:, 1])) * 100.0
+
+
+def score_simon(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
+    """Simon worst-fit score (simon.go:45-68): max over resources of
+    share(req, allocatable - req), truncated to int, then min-max normalized.
+    Note the reference deliberately uses static allocatable, not current free.
+    """
+    req = pod.req[None, :]                       # [1,R]
+    avail = ns.alloc - req                       # [N,R]
+    share = jnp.where(
+        req == 0,
+        0.0,
+        jnp.where(avail == 0, 1.0, req / jnp.where(avail == 0, 1.0, avail)),
+    )
+    share = jnp.where(avail < 0, 1.0, share)     # negative headroom: saturate
+    raw = jnp.floor(jnp.max(share, axis=1) * 100.0)
+    raw = jnp.where(pod.has_req, raw, 100.0)     # empty requests => MaxNodeScore
+    return _minmax_normalize(raw, ns.valid)
+
+
+def score_taint_toleration(ns: NodeStatic, pod: PodRow) -> jnp.ndarray:
+    """TaintToleration score: fewer intolerable PreferNoSchedule taints is
+    better; reverse-normalized like plugin DefaultNormalizeScore(reverse)."""
+    tk, tv, te = ns.taint_key, ns.taint_val, ns.taint_effect
+    eff_ok = (pod.tol_effect[None, None, :] == 0) | (pod.tol_effect[None, None, :] == te[:, :, None])
+    key_ok = (pod.tol_key[None, None, :] == 0) | (pod.tol_key[None, None, :] == tk[:, :, None])
+    val_ok = pod.tol_exists[None, None, :] | (pod.tol_val[None, None, :] == tv[:, :, None])
+    tolerated = jnp.any(pod.tol_valid[None, None, :] & eff_ok & key_ok & val_ok, axis=2)
+    cnt = jnp.sum(((te == 2) & ~tolerated).astype(jnp.float32), axis=1)
+    max_cnt = jnp.max(jnp.where(ns.valid, cnt, 0.0))
+    return jnp.where(max_cnt > 0, (max_cnt - cnt) * 100.0 / jnp.maximum(max_cnt, 1e-9), 100.0)
+
+
+def score_node_affinity(ns: NodeStatic, pod: PodRow) -> jnp.ndarray:
+    """NodeAffinity score: sum of matching preferred term weights, normalized
+    by the max (DefaultNormalizeScore)."""
+    hits = jax.vmap(
+        lambda o, k, v, n: _term_matches(ns, o, k, v, n),
+        in_axes=(0, 0, 0, 0),
+        out_axes=1,
+    )(pod.pref_op, pod.pref_key, pod.pref_val, pod.pref_num)    # [N,PREF]
+    raw = jnp.sum(hits * pod.pref_weight[None, :], axis=1)
+    mx = jnp.max(jnp.where(ns.valid, raw, 0.0))
+    return jnp.where(mx > 0, raw * 100.0 / jnp.maximum(mx, 1e-9), 0.0)
+
+
+def score_prefer_avoid(ns: NodeStatic, pod: PodRow) -> jnp.ndarray:
+    """NodePreferAvoidPods: 0 on annotated nodes for RS/RC-owned pods."""
+    avoided = ns.avoid_pods & pod.owned_by_rs
+    return jnp.where(avoided, 0.0, 100.0)
+
+
+def score_topology_spread(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
+    """PodTopologySpread soft constraints: lower matching-count domains score
+    higher (reverse-normalized sum over ScheduleAnyway constraints)."""
+
+    def one(topo_idx, sel_idx, hard):
+        active = (topo_idx >= 0) & ~hard
+        k = jnp.maximum(topo_idx, 0)
+        topo_k = ns.topo[:, k]
+        dom = _domain_counts(ns, carry.sel_counts[sel_idx], topo_k)
+        cnt = jnp.where(topo_k >= 0, dom[jnp.maximum(topo_k, 0)], 0.0)
+        return jnp.where(active, cnt, 0.0)
+
+    raw = jnp.sum(
+        jax.vmap(one, in_axes=(0, 0, 0), out_axes=1)(
+            pod.spread_topo, pod.spread_sel, pod.spread_hard
+        ),
+        axis=1,
+    )
+    mx = jnp.max(jnp.where(ns.valid, raw, 0.0))
+    return jnp.where(mx > 0, (mx - raw) * 100.0 / jnp.maximum(mx, 1e-9), 100.0)
+
+
+def score_inter_pod_affinity(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
+    """InterPodAffinity preferred terms: +weight per matching pod in domain for
+    affinity, -weight for anti-affinity; min-max normalized to 0..100."""
+
+    def one(topo_idx, sel_idx, anti, required, weight):
+        active = (topo_idx >= 0) & ~required
+        k = jnp.maximum(topo_idx, 0)
+        topo_k = ns.topo[:, k]
+        dom = _domain_counts(ns, carry.sel_counts[sel_idx], topo_k)
+        cnt = jnp.where(topo_k >= 0, dom[jnp.maximum(topo_k, 0)], 0.0)
+        signed = jnp.where(anti, -weight, weight) * cnt
+        return jnp.where(active, signed, 0.0)
+
+    raw = jnp.sum(
+        jax.vmap(one, in_axes=(0, 0, 0, 0, 0), out_axes=1)(
+            pod.aff_topo, pod.aff_sel, pod.aff_anti, pod.aff_required, pod.aff_weight
+        ),
+        axis=1,
+    )
+    any_active = jnp.any((pod.aff_topo >= 0) & ~pod.aff_required)
+    normalized = _minmax_normalize(raw, ns.valid)
+    return jnp.where(any_active, normalized, 0.0)
+
+
+def run_scores(ns: NodeStatic, carry: Carry, pod: PodRow, weights: jnp.ndarray) -> jnp.ndarray:
+    """Weighted sum of all normalized score plugins -> f32[N]."""
+    by_name = {
+        "balanced_allocation": score_balanced(ns, carry, pod),
+        "least_allocated": score_least_allocated(ns, carry, pod),
+        "node_affinity": score_node_affinity(ns, pod),
+        "taint_toleration": score_taint_toleration(ns, pod),
+        "topology_spread": score_topology_spread(ns, carry, pod),
+        "inter_pod_affinity": score_inter_pod_affinity(ns, carry, pod),
+        "prefer_avoid_pods": score_prefer_avoid(ns, pod),
+        "simon": score_simon(ns, carry, pod),
+    }
+    stacked = jnp.stack([by_name[k] for k in WEIGHT_ORDER], axis=0)  # [W,N]
+    return jnp.sum(stacked * weights[:, None], axis=0)
+
+
+# ---------------------------------------------------------------------------
+# The scan: sequential commit of a pod batch in one device computation
+# ---------------------------------------------------------------------------
+
+def schedule_step(ns: NodeStatic, weights: jnp.ndarray, carry: Carry, pod: PodRow):
+    mask, first_fail = run_filters(ns, carry, pod)
+    score = run_scores(ns, carry, pod, weights)
+    score = jnp.where(mask, score, -jnp.inf)
+    node = jnp.argmax(score)  # first max => lowest node index tie-break
+    ok = jnp.any(mask) & pod.valid
+    node_out = jnp.where(ok, node, -1)
+
+    onehot = (jnp.arange(ns.valid.shape[0]) == node) & ok
+    free = carry.free - onehot[:, None] * pod.req[None, :]
+    sel_counts = carry.sel_counts + (
+        pod.match_sel.astype(jnp.float32)[:, None] * onehot.astype(jnp.float32)[None, :]
+    )
+
+    reason_counts = jnp.zeros(NUM_FILTERS, jnp.int32).at[
+        jnp.clip(first_fail, 0, NUM_FILTERS - 1)
+    ].add(jnp.where((first_fail < NUM_FILTERS) & ns.valid, 1, 0))
+    reason_counts = jnp.where(ok, jnp.zeros_like(reason_counts), reason_counts)
+
+    new_carry = Carry(free=free, sel_counts=sel_counts)
+    return new_carry, (node_out.astype(jnp.int32), reason_counts)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def schedule_batch(ns: NodeStatic, carry: Carry, pods: PodRow, weights: jnp.ndarray):
+    """Schedule a whole PodBatch sequentially on device.
+
+    Returns (final_carry, nodes i32[P] (-1 = unschedulable), reasons i32[P,F]).
+    """
+
+    def step(c, pod):
+        return schedule_step(ns, weights, c, pod)
+
+    final_carry, (nodes, reasons) = jax.lax.scan(step, carry, pods)
+    return final_carry, nodes, reasons

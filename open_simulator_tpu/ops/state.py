@@ -1,0 +1,97 @@
+"""Host↔device state plumbing: numpy tables → jnp pytrees and back.
+
+The reference's equivalent is the informer/watch machinery that keeps the
+scheduler cache in sync with the fake apiserver
+(`/root/reference/pkg/simulator/simulator.go:127-187`). Here the whole cluster
+ships to the device once, and the only thing that ever comes back per batch is
+the placement vector and failure-reason counts.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .encode import Encoder, NodeTable, PodBatch, round_up
+from .kernels import Carry, NodeStatic, PodRow
+
+
+def node_static_from_table(enc: Encoder, table: NodeTable) -> NodeStatic:
+    D = round_up(len(enc.domains) + 1, 4)
+    domain_key = np.full(D, -1, np.int32)
+    for did_minus1, k_idx in enumerate(enc.domain_topo):
+        domain_key[did_minus1 + 1] = k_idx
+    return NodeStatic(
+        alloc=jnp.asarray(table.alloc),
+        label_pair=jnp.asarray(table.label_pair),
+        label_key=jnp.asarray(table.label_key),
+        label_num=jnp.asarray(table.label_num),
+        taint_key=jnp.asarray(table.taint_key),
+        taint_val=jnp.asarray(table.taint_val),
+        taint_effect=jnp.asarray(table.taint_effect),
+        name_id=jnp.asarray(table.name_id),
+        unsched=jnp.asarray(table.unsched),
+        avoid_pods=jnp.asarray(table.avoid_pods),
+        topo=jnp.asarray(table.topo),
+        valid=jnp.asarray(table.valid),
+        domain_key=jnp.asarray(domain_key),
+        unsched_key_id=jnp.int32(enc.unsched_key_id),
+        empty_val_id=jnp.int32(enc.empty_val_id),
+    )
+
+
+def carry_from_table(
+    table: NodeTable, sel_counts: Optional[np.ndarray] = None, num_selectors: int = 1
+) -> Carry:
+    if sel_counts is None:
+        sel_counts = np.zeros((max(num_selectors, 1), table.n), np.float32)
+    return Carry(free=jnp.asarray(table.free), sel_counts=jnp.asarray(sel_counts))
+
+
+def pod_rows_from_batch(batch: PodBatch) -> PodRow:
+    """Stacked PodRow pytree ([P, ...] leaves) for lax.scan."""
+    return PodRow(
+        req=jnp.asarray(batch.req),
+        has_req=jnp.asarray(batch.has_req),
+        node_name_id=jnp.asarray(batch.node_name_id),
+        sel_op=jnp.asarray(batch.sel_op),
+        sel_key=jnp.asarray(batch.sel_key),
+        sel_val=jnp.asarray(batch.sel_val),
+        sel_num=jnp.asarray(batch.sel_num),
+        has_terms=jnp.asarray(batch.has_terms),
+        ns_pair=jnp.asarray(batch.ns_pair),
+        pref_weight=jnp.asarray(batch.pref_weight),
+        pref_op=jnp.asarray(batch.pref_op),
+        pref_key=jnp.asarray(batch.pref_key),
+        pref_val=jnp.asarray(batch.pref_val),
+        pref_num=jnp.asarray(batch.pref_num),
+        tol_key=jnp.asarray(batch.tol_key),
+        tol_val=jnp.asarray(batch.tol_val),
+        tol_exists=jnp.asarray(batch.tol_exists),
+        tol_effect=jnp.asarray(batch.tol_effect),
+        tol_valid=jnp.asarray(batch.tol_valid),
+        spread_topo=jnp.asarray(batch.spread_topo),
+        spread_sel=jnp.asarray(batch.spread_sel),
+        spread_skew=jnp.asarray(batch.spread_skew),
+        spread_hard=jnp.asarray(batch.spread_hard),
+        aff_topo=jnp.asarray(batch.aff_topo),
+        aff_sel=jnp.asarray(batch.aff_sel),
+        aff_anti=jnp.asarray(batch.aff_anti),
+        aff_required=jnp.asarray(batch.aff_required),
+        aff_weight=jnp.asarray(batch.aff_weight),
+        match_sel=jnp.asarray(batch.match_sel),
+        owned_by_rs=jnp.asarray(batch.owned_by_rs),
+        valid=jnp.asarray(batch.valid),
+    )
+
+
+def align_sel_counts(carry: Carry, num_selectors: int) -> Carry:
+    """Grow the selector axis when a later app introduces new selectors."""
+    S_old, N = carry.sel_counts.shape
+    S = max(num_selectors, 1)
+    if S <= S_old:
+        return carry
+    grown = jnp.zeros((S, N), jnp.float32).at[:S_old].set(carry.sel_counts)
+    return Carry(free=carry.free, sel_counts=grown)

@@ -1,0 +1,406 @@
+"""Device-kernel tests: feasibility masks must agree with the pure-Python
+oracle (core.matcher) on randomized clusters, and the scan must reproduce the
+sequential-commit behaviors of the reference scheduler."""
+
+import numpy as np
+import pytest
+
+from open_simulator_tpu.core.matcher import (
+    fits_resources,
+    match_node_affinity,
+    untolerated_taint,
+)
+from open_simulator_tpu.core.objects import Node, Pod
+from open_simulator_tpu.ops.encode import (
+    Encoder,
+    encode_nodes,
+    encode_pods,
+    initial_selector_counts,
+)
+from open_simulator_tpu.ops.kernels import (
+    F_RESOURCES,
+    F_TAINT,
+    NUM_FILTERS,
+    run_filters,
+    schedule_batch,
+    weights_array,
+)
+from open_simulator_tpu.ops.state import (
+    carry_from_table,
+    node_static_from_table,
+    pod_rows_from_batch,
+)
+
+import jax
+
+
+def mknode(name, cpu="8", mem="16Gi", labels=None, taints=None, unschedulable=False):
+    return Node.from_dict(
+        {
+            "metadata": {"name": name, "labels": labels or {}},
+            "spec": {"taints": taints or [], "unschedulable": unschedulable},
+            "status": {"allocatable": {"cpu": cpu, "memory": mem, "pods": "110"}},
+        }
+    )
+
+
+def mkpod(name, cpu="1", mem="1Gi", ns="default", **spec_extra):
+    spec = {
+        "containers": [
+            {"name": "c", "resources": {"requests": {"cpu": cpu, "memory": mem}}}
+        ]
+    }
+    spec.update(spec_extra)
+    return Pod.from_dict({"metadata": {"name": name, "namespace": ns}, "spec": spec})
+
+
+def encode_all(nodes, pods, placed=()):
+    enc = Encoder()
+    enc.register_pods(pods)
+    table = encode_nodes(enc, nodes)
+    batch = encode_pods(enc, pods)
+    ns = node_static_from_table(enc, table)
+    carry = carry_from_table(table, initial_selector_counts(enc, table, list(placed)))
+    rows = pod_rows_from_batch(batch)
+    return enc, table, batch, ns, carry, rows
+
+
+def run(nodes, pods, placed=()):
+    enc, table, batch, ns, carry, rows = encode_all(nodes, pods, placed)
+    carry2, placed_idx, reasons = schedule_batch(ns, carry, rows, weights_array())
+    names = [table.names[i] if i >= 0 else None for i in np.asarray(placed_idx)[: len(pods)]]
+    return names, np.asarray(reasons), np.asarray(carry2.free), table
+
+
+# ---------------------------------------------------------------------------
+# Oracle agreement on randomized inputs
+# ---------------------------------------------------------------------------
+
+def test_filters_match_python_oracle_randomized():
+    rng = np.random.default_rng(7)
+    keys = ["zone", "disk", "arch", "role"]
+    values = ["a", "b", "c"]
+    effects = ["NoSchedule", "PreferNoSchedule", "NoExecute"]
+    for trial in range(6):
+        nodes = []
+        for i in range(8):
+            labels = {
+                k: str(rng.choice(values)) for k in keys if rng.random() < 0.6
+            }
+            taints = [
+                {
+                    "key": str(rng.choice(keys)),
+                    "value": str(rng.choice(values)),
+                    "effect": str(rng.choice(effects)),
+                }
+                for _ in range(rng.integers(0, 3))
+            ]
+            nodes.append(
+                mknode(
+                    f"n{i}",
+                    cpu=str(rng.integers(1, 9)),
+                    mem=f"{rng.integers(1, 17)}Gi",
+                    labels=labels,
+                    taints=taints,
+                    unschedulable=bool(rng.random() < 0.1),
+                )
+            )
+        pods = []
+        for j in range(6):
+            spec = {}
+            if rng.random() < 0.5:
+                spec["nodeSelector"] = {str(rng.choice(keys)): str(rng.choice(values))}
+            if rng.random() < 0.5:
+                spec["tolerations"] = [
+                    {
+                        "key": str(rng.choice(keys)),
+                        "operator": str(rng.choice(["Equal", "Exists"])),
+                        "value": str(rng.choice(values)),
+                        "effect": str(rng.choice(effects + [""])),
+                    }
+                ]
+            if rng.random() < 0.4:
+                spec["affinity"] = {
+                    "nodeAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": {
+                            "nodeSelectorTerms": [
+                                {
+                                    "matchExpressions": [
+                                        {
+                                            "key": str(rng.choice(keys)),
+                                            "operator": str(
+                                                rng.choice(
+                                                    ["In", "NotIn", "Exists", "DoesNotExist"]
+                                                )
+                                            ),
+                                            "values": [str(rng.choice(values))],
+                                        }
+                                    ]
+                                }
+                            ]
+                        }
+                    }
+                }
+            pods.append(
+                mkpod(f"p{j}", cpu=str(rng.integers(1, 5)), mem=f"{rng.integers(1, 9)}Gi", **spec)
+            )
+
+        enc, table, batch, ns, carry, rows = encode_all(nodes, pods)
+        for j, pod in enumerate(pods):
+            row = jax.tree.map(lambda a: a[j], rows)
+            mask, first_fail = run_filters(ns, carry, row)
+            mask = np.asarray(mask)
+            for i, node in enumerate(nodes):
+                free = {
+                    r: node.allocatable.get(r, 0) for r in node.allocatable
+                }
+                expect = (
+                    not node.unschedulable
+                    and untolerated_taint(pod.tolerations, node) is None
+                    and match_node_affinity(pod, node)
+                    and not fits_resources(pod, free)
+                )
+                assert mask[i] == expect, (
+                    f"trial {trial} pod {j} node {i}: kernel={mask[i]} oracle={expect}\n"
+                    f"pod={pod}\nnode={node}"
+                )
+
+
+# ---------------------------------------------------------------------------
+# Sequential-commit behaviors
+# ---------------------------------------------------------------------------
+
+def test_resource_exhaustion_and_reasons():
+    nodes = [mknode("a", cpu="2", mem="4Gi"), mknode("b", cpu="2", mem="4Gi")]
+    pods = [mkpod(f"p{i}", cpu="1500m", mem="1Gi") for i in range(4)]
+    names, reasons, free, _ = run(nodes, pods)
+    assert names[0] is not None and names[1] is not None
+    assert set(names[:2]) == {"a", "b"}  # spreading via least-allocated
+    assert names[2] is None and names[3] is None
+    assert reasons[2][F_RESOURCES] == 2
+
+
+def test_node_name_pinning():
+    nodes = [mknode("a"), mknode("b")]
+    pods = [mkpod("p0", nodeName="b")]
+    names, _, _, _ = run(nodes, pods)
+    assert names == ["b"]
+
+
+def test_taints_and_tolerations():
+    taint = [{"key": "dedicated", "value": "gpu", "effect": "NoSchedule"}]
+    nodes = [mknode("tainted", taints=taint), mknode("open", cpu="1", mem="1Gi")]
+    # intolerant pod that only fits the tainted node -> unschedulable there
+    big = mkpod("big", cpu="4", mem="4Gi")
+    names, reasons, _, _ = run(nodes, [big])
+    assert names == [None]
+    assert reasons[0][F_TAINT] == 1
+    # tolerant pod lands on the tainted node
+    tol = mkpod(
+        "tol", cpu="4", mem="4Gi",
+        tolerations=[{"key": "dedicated", "operator": "Equal", "value": "gpu", "effect": "NoSchedule"}],
+    )
+    names, _, _, _ = run(nodes, [tol])
+    assert names == ["tainted"]
+
+
+def test_node_selector_and_affinity():
+    nodes = [
+        mknode("ssd", labels={"disk": "ssd"}),
+        mknode("hdd", labels={"disk": "hdd"}),
+    ]
+    pods = [
+        mkpod("sel", nodeSelector={"disk": "ssd"}),
+        mkpod(
+            "aff",
+            affinity={
+                "nodeAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": [
+                            {
+                                "matchExpressions": [
+                                    {"key": "disk", "operator": "In", "values": ["hdd"]}
+                                ]
+                            }
+                        ]
+                    }
+                }
+            },
+        ),
+    ]
+    names, _, _, _ = run(nodes, pods)
+    assert names == ["ssd", "hdd"]
+
+
+def test_preferred_affinity_steers():
+    nodes = [mknode("a", labels={"zone": "a"}), mknode("b", labels={"zone": "b"})]
+    pod = mkpod(
+        "p",
+        affinity={
+            "nodeAffinity": {
+                "preferredDuringSchedulingIgnoredDuringExecution": [
+                    {
+                        "weight": 100,
+                        "preference": {
+                            "matchExpressions": [
+                                {"key": "zone", "operator": "In", "values": ["b"]}
+                            ]
+                        },
+                    }
+                ]
+            }
+        },
+    )
+    names, _, _, _ = run(nodes, [pod])
+    assert names == ["b"]
+
+
+def test_anti_affinity_spreads_replicas():
+    nodes = [mknode(f"n{i}") for i in range(3)]
+    anti = {
+        "podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {
+                    "labelSelector": {"matchLabels": {"app": "web"}},
+                    "topologyKey": "kubernetes.io/hostname",
+                }
+            ]
+        }
+    }
+    pods = []
+    for i in range(4):
+        p = Pod.from_dict(
+            {
+                "metadata": {"name": f"w{i}", "namespace": "d", "labels": {"app": "web"}},
+                "spec": {
+                    "containers": [{"name": "c", "resources": {"requests": {"cpu": "1"}}}],
+                    "affinity": anti,
+                },
+            }
+        )
+        pods.append(p)
+    names, reasons, _, _ = run(nodes, pods)
+    # 3 replicas land on 3 distinct nodes; the 4th has nowhere left
+    assert sorted(n for n in names[:3]) == ["n0", "n1", "n2"]
+    assert names[3] is None
+    assert reasons[3][NUM_FILTERS - 1] == 3
+
+
+def test_required_pod_affinity_collocates():
+    nodes = [
+        mknode("za1", labels={"zone": "a"}),
+        mknode("zb1", labels={"zone": "b"}),
+    ]
+    base = Pod.from_dict(
+        {
+            "metadata": {"name": "db", "namespace": "d", "labels": {"app": "db"}},
+            "spec": {
+                "containers": [{"name": "c", "resources": {"requests": {"cpu": "1"}}}],
+                "nodeSelector": {"zone": "b"},
+            },
+        }
+    )
+    follower = Pod.from_dict(
+        {
+            "metadata": {"name": "web", "namespace": "d", "labels": {"app": "web"}},
+            "spec": {
+                "containers": [{"name": "c", "resources": {"requests": {"cpu": "1"}}}],
+                "affinity": {
+                    "podAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": [
+                            {
+                                "labelSelector": {"matchLabels": {"app": "db"}},
+                                "topologyKey": "zone",
+                            }
+                        ]
+                    }
+                },
+            },
+        }
+    )
+    names, _, _, _ = run(nodes, [base, follower])
+    assert names == ["zb1", "zb1"]
+
+
+def test_self_affinity_first_pod_bootstraps():
+    nodes = [mknode("a", labels={"zone": "a"})]
+    pod = Pod.from_dict(
+        {
+            "metadata": {"name": "g0", "namespace": "d", "labels": {"app": "g"}},
+            "spec": {
+                "containers": [{"name": "c", "resources": {"requests": {"cpu": "1"}}}],
+                "affinity": {
+                    "podAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": [
+                            {
+                                "labelSelector": {"matchLabels": {"app": "g"}},
+                                "topologyKey": "zone",
+                            }
+                        ]
+                    }
+                },
+            },
+        }
+    )
+    names, _, _, _ = run(nodes, [pod])
+    assert names == ["a"]
+
+
+def test_topology_spread_hard():
+    nodes = [
+        mknode("a1", labels={"zone": "a"}),
+        mknode("a2", labels={"zone": "a"}),
+        mknode("b1", labels={"zone": "b"}),
+    ]
+    pods = []
+    for i in range(4):
+        pods.append(
+            Pod.from_dict(
+                {
+                    "metadata": {"name": f"s{i}", "namespace": "d", "labels": {"app": "s"}},
+                    "spec": {
+                        "containers": [{"name": "c", "resources": {"requests": {"cpu": "1"}}}],
+                        "topologySpreadConstraints": [
+                            {
+                                "maxSkew": 1,
+                                "topologyKey": "zone",
+                                "whenUnsatisfiable": "DoNotSchedule",
+                                "labelSelector": {"matchLabels": {"app": "s"}},
+                            }
+                        ],
+                    },
+                }
+            )
+        )
+    names, _, _, _ = run(nodes, pods)
+    zones = {"a1": "a", "a2": "a", "b1": "b"}
+    placed_zones = [zones[n] for n in names]
+    # after 4 pods the skew |a - b| must stay <= 1 at every prefix
+    for k in range(1, 5):
+        prefix = placed_zones[:k]
+        assert abs(prefix.count("a") - prefix.count("b")) <= 1
+
+
+def test_unschedulable_node():
+    nodes = [mknode("u", unschedulable=True), mknode("ok")]
+    names, _, _, _ = run(nodes, [mkpod("p")])
+    assert names == ["ok"]
+
+
+def test_existing_pods_consume_free():
+    nodes = [mknode("a", cpu="4", mem="8Gi")]
+    existing = mkpod("old", cpu="3", mem="1Gi")
+    enc = Encoder()
+    pods = [mkpod("new", cpu="2", mem="1Gi")]
+    enc.register_pods(pods)
+    usage = {"a": existing.requests}
+    from open_simulator_tpu.ops.encode import encode_nodes as en
+
+    table = en(enc, nodes, existing_usage=usage)
+    batch = encode_pods(enc, pods)
+    ns = node_static_from_table(enc, table)
+    carry = carry_from_table(table, initial_selector_counts(enc, table, [(existing, "a")]))
+    rows = pod_rows_from_batch(batch)
+    _, placed, reasons = schedule_batch(ns, carry, rows, weights_array())
+    assert np.asarray(placed)[0] == -1  # only 1 cpu free, pod wants 2
+    assert np.asarray(reasons)[0][F_RESOURCES] == 1
