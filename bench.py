@@ -1,0 +1,191 @@
+"""Headline benchmark: the BASELINE.json north-star config.
+
+Capacity-plans a 100k-pod workload onto a 10k-node simulated cluster — the
+full pod×node Filter/Score/Select sweep with sequential commit — on one TPU
+chip, and reports scheduling throughput.
+
+Baseline: the reference publishes no numbers (BASELINE.md); the driver-defined
+target is 100k pods onto 10k nodes in <60s on a v5e-8, i.e. 1667 pods/s.
+vs_baseline is throughput relative to that target (>1.0 beats it).
+
+Output: one JSON line, e.g.
+  {"metric": "schedule_100k_pods_10k_nodes", "value": 2560.0,
+   "unit": "pods/s", "vs_baseline": 1.54, ...}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+TARGET_PODS_PER_SEC = 100_000 / 60.0  # driver north star
+
+
+def build_state(n_nodes: int, n_pods: int):
+    from open_simulator_tpu.core.objects import Node, Pod
+    from open_simulator_tpu.ops.encode import (
+        Encoder,
+        encode_nodes,
+        encode_pods,
+        initial_selector_counts,
+    )
+    from open_simulator_tpu.ops.state import (
+        carry_from_table,
+        node_static_from_table,
+        pod_rows_from_batch,
+    )
+    from open_simulator_tpu.ops.tile import tile_pod_batch
+
+    rng = np.random.default_rng(0)
+    nodes = []
+    for i in range(n_nodes):
+        taints = (
+            [{"key": "dedicated", "value": "batch", "effect": "NoSchedule"}]
+            if i % 10 == 0
+            else []
+        )
+        nodes.append(
+            Node.from_dict(
+                {
+                    "metadata": {
+                        "name": f"node-{i}",
+                        "labels": {
+                            "kubernetes.io/hostname": f"node-{i}",
+                            "topology.kubernetes.io/zone": f"az-{i % 3}",
+                            "node.kubernetes.io/instance-type": ["m5.4x", "m5.8x", "c5.9x"][i % 3],
+                        },
+                    },
+                    "spec": {"taints": taints},
+                    "status": {
+                        "allocatable": {
+                            "cpu": str(16 + 16 * int(rng.integers(0, 3))),
+                            "memory": f"{32 + 32 * int(rng.integers(0, 3))}Gi",
+                            "pods": "110",
+                        }
+                    },
+                }
+            )
+        )
+
+    # Workload templates: a service with zone spread, a tolerating batch job,
+    # a selector-pinned cache, a plain web tier.
+    templates = []
+    tmpl_specs = [
+        dict(
+            cpu="500m", mem="512Mi", labels={"app": "web"},
+            spread=True, tol=False, sel=None,
+        ),
+        dict(
+            cpu="2", mem="4Gi", labels={"app": "batch"},
+            spread=False, tol=True, sel=None,
+        ),
+        dict(
+            cpu="1", mem="2Gi", labels={"app": "cache"},
+            spread=False, tol=False, sel={"node.kubernetes.io/instance-type": "m5.8x"},
+        ),
+        dict(
+            cpu="250m", mem="256Mi", labels={"app": "sidecar"},
+            spread=True, tol=False, sel=None,
+        ),
+    ]
+    for t, s in enumerate(tmpl_specs):
+        spec = {
+            "containers": [
+                {"name": "c", "resources": {"requests": {"cpu": s["cpu"], "memory": s["mem"]}}}
+            ]
+        }
+        if s["spread"]:
+            spec["topologySpreadConstraints"] = [
+                {
+                    "maxSkew": 50,
+                    "topologyKey": "topology.kubernetes.io/zone",
+                    "whenUnsatisfiable": "ScheduleAnyway",
+                    "labelSelector": {"matchLabels": s["labels"]},
+                }
+            ]
+        if s["tol"]:
+            spec["tolerations"] = [
+                {"key": "dedicated", "operator": "Equal", "value": "batch",
+                 "effect": "NoSchedule"}
+            ]
+        if s["sel"]:
+            spec["nodeSelector"] = s["sel"]
+        templates.append(
+            Pod.from_dict(
+                {
+                    "metadata": {
+                        "name": f"tpl-{t}", "namespace": "bench", "labels": s["labels"],
+                    },
+                    "spec": spec,
+                }
+            )
+        )
+
+    share = n_pods // len(templates)
+    counts = [share] * len(templates)
+    counts[0] += n_pods - share * len(templates)
+
+    enc = Encoder()
+    enc.register_pods(templates)
+    table = encode_nodes(enc, nodes)
+    tmpl_batch = encode_pods(enc, templates)
+    batch = tile_pod_batch(tmpl_batch, counts)
+    ns = node_static_from_table(enc, table)
+    carry = carry_from_table(table, initial_selector_counts(enc, table, []))
+    return ns, carry, batch
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--pods", type=int, default=100_000)
+    parser.add_argument("--nodes", type=int, default=10_000)
+    parser.add_argument("--quick", action="store_true", help="tiny smoke sizes")
+    args = parser.parse_args()
+    if args.quick:
+        args.pods, args.nodes = 2_000, 200
+
+    import jax
+
+    from open_simulator_tpu.ops.chunked import schedule_batch_chunked
+    from open_simulator_tpu.ops.kernels import weights_array
+
+    t_enc0 = time.time()
+    ns, carry, batch = build_state(args.nodes, args.pods)
+    t_enc = time.time() - t_enc0
+    w = weights_array()
+
+    # Warm up with one full untimed pass (same shapes => same executables),
+    # then one timed pass. Chunked execution bounds each device program to a
+    # few seconds (a single 100k-step scan trips the TPU worker's watchdog).
+    t0 = time.time()
+    schedule_batch_chunked(ns, carry, batch, w)
+    compile_s = time.time() - t0
+
+    t1 = time.time()
+    _, placed, _ = schedule_batch_chunked(ns, carry, batch, w)
+    run = time.time() - t1
+    scheduled = int((placed >= 0).sum())
+    pods_per_sec = args.pods / run
+    result = {
+        "metric": f"schedule_{args.pods//1000}k_pods_{args.nodes//1000}k_nodes",
+        "value": round(pods_per_sec, 1),
+        "unit": "pods/s",
+        "vs_baseline": round(pods_per_sec / TARGET_PODS_PER_SEC, 3),
+        "wall_s": round(run, 2),
+        "compile_s": round(compile_s, 2),
+        "encode_s": round(t_enc, 2),
+        "scheduled": scheduled,
+        "pods": args.pods,
+        "nodes": args.nodes,
+        "device": str(jax.devices()[0]),
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,106 @@
+"""simon CLI: apply / server / version / gen-doc.
+
+Parity: `/root/reference/cmd/` (cobra commands → argparse subcommands):
+  apply   -f/--simon-config, --output-file, -i/--interactive, --use-greed,
+          --extended-resources (cmd/apply/apply.go:27-32)
+  server  --port (cmd/server/*; the reference binds a real cluster via
+          kubeconfig — ours serves simulations over snapshots)
+  version (cmd/version/version.go)
+  gen-doc (cmd/doc/generate_markdown.go)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+VERSION = "0.1.0"
+
+
+def _add_apply(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser("apply", help="simulate deploying applications")
+    p.add_argument("-f", "--simon-config", required=True, help="path of simon config")
+    p.add_argument("--output-file", default="", help="write the report to a file")
+    p.add_argument(
+        "-i", "--interactive", action="store_true",
+        help="reference-style interactive add-node loop",
+    )
+    p.add_argument(
+        "--no-auto-plan", action="store_true",
+        help="disable the automatic add-node capacity search",
+    )
+    p.add_argument(
+        "--use-greed", action="store_true",
+        help="accepted for CLI parity (the reference flag is not wired either, "
+        "pkg/algo/greed.go vs simulator.go:238-241)",
+    )
+    p.add_argument(
+        "--extended-resources", default="",
+        help="comma list: gpu,open-local (extended report views)",
+    )
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    parser = argparse.ArgumentParser(
+        prog="simon",
+        description="TPU-native cluster scheduling simulator (open-simulator capabilities)",
+    )
+    sub = parser.add_subparsers(dest="command")
+    _add_apply(sub)
+    ps = sub.add_parser("server", help="run the REST simulation service")
+    ps.add_argument("--port", type=int, default=9998)
+    ps.add_argument("--kubeconfig", default="", help="accepted for parity; unused")
+    sub.add_parser("version", help="print version")
+    pd = sub.add_parser("gen-doc", help="generate CLI markdown docs")
+    pd.add_argument("--output-dir", default="./docs/commandline")
+
+    args = parser.parse_args(argv)
+    if args.command == "version":
+        print(f"simon-tpu version {VERSION}")
+        return 0
+    if args.command == "gen-doc":
+        return _gen_doc(parser, args.output_dir)
+    if args.command == "server":
+        from ..server.server import serve
+
+        return serve(port=args.port)
+    if args.command == "apply":
+        from ..api.config import SimonConfig
+        from ..engine.apply import ApplyError, run_apply
+
+        try:
+            cfg = SimonConfig.load(args.simon_config)
+            out = open(args.output_file, "w") if args.output_file else None
+            try:
+                outcome = run_apply(
+                    cfg,
+                    interactive=args.interactive,
+                    auto_plan=not args.no_auto_plan,
+                    out=out,
+                )
+            finally:
+                if out is not None:
+                    out.close()
+        except (ApplyError, ValueError, OSError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        return 0 if not outcome.result.unscheduled else 2
+    parser.print_help()
+    return 0
+
+
+def _gen_doc(parser: argparse.ArgumentParser, output_dir: str) -> int:
+    os.makedirs(output_dir, exist_ok=True)
+    path = os.path.join(output_dir, "simon.md")
+    with open(path, "w") as fh:
+        fh.write("# simon\n\n```\n")
+        fh.write(parser.format_help())
+        fh.write("```\n")
+    print(f"wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -401,7 +401,7 @@ class Pod:
         defaults to 1 when only gpu-mem is set."""
         v = self.meta.annotations.get(ANNO_GPU_COUNT_POD)
         try:
-            if v is not None:
+            if v is not None and int(v) >= 0:  # reference rejects negatives
                 return int(v)
         except ValueError:
             pass

@@ -1,0 +1,179 @@
+"""The Applier: config → cluster/apps → simulate → (capacity plan) → report.
+
+Parity: `/root/reference/pkg/apply/apply.go` (NewApplier/Run): builds cluster
+from the custom config dir (or a real cluster via kubeconfig — not available in
+this environment, cleanly rejected), renders each app (chart or manifest dir),
+runs the simulation, and on unschedulable pods enters the add-node flow. The
+reference's flow is interactive-only; ours defaults to the automatic bisection
+search (engine/capacity.py) with interactive kept as an option.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from dataclasses import dataclass, field
+from typing import List, Optional, TextIO
+
+from ..api.config import SimonConfig
+from ..core.objects import Node
+from ..utils.yamlio import (
+    json_files_by_stem,
+    load_yaml_documents,
+    objects_from_directory,
+)
+from .capacity import CapacityPlan, new_fake_nodes, plan_capacity
+from .report import full_report
+from .simulator import AppResource, ClusterResource, SimulateResult, simulate
+
+
+class ApplyError(Exception):
+    pass
+
+
+def build_cluster(cfg: SimonConfig) -> ClusterResource:
+    if cfg.kube_config:
+        raise ApplyError(
+            "spec.cluster.kubeConfig requires access to a live cluster, which "
+            "this environment does not provide; use spec.cluster.customConfig "
+            "with a directory of manifests (see example/)"
+        )
+    objs = objects_from_directory(cfg.custom_config)
+    cluster = ClusterResource.from_objects(objs)
+    if not cluster.nodes:
+        raise ApplyError(f"no Node objects found under {cfg.custom_config}")
+    cluster.attach_local_storage(json_files_by_stem(cfg.custom_config))
+    return cluster
+
+
+def render_chart(path: str, name: str) -> List[dict]:
+    """Helm chart rendering. Uses the helm binary when present; otherwise a
+    clear error (the reference links helm v3 as a library, `pkg/chart/chart.go`)."""
+    helm = shutil.which("helm")
+    if helm is None:
+        raise ApplyError(
+            f"app {name}: chart rendering requires the helm binary, which is "
+            "not installed; pre-render the chart (helm template) and point the "
+            "app path at the output directory instead"
+        )
+    proc = subprocess.run(
+        [helm, "template", name, path],
+        capture_output=True,
+        text=True,
+        check=False,
+    )
+    if proc.returncode != 0:
+        raise ApplyError(f"helm template failed for {name}: {proc.stderr.strip()}")
+    return load_yaml_documents(proc.stdout)
+
+
+def build_apps(cfg: SimonConfig) -> List[AppResource]:
+    apps = []
+    for app in cfg.app_list:
+        if app.chart:
+            objects = render_chart(app.path, app.name)
+        else:
+            objects = objects_from_directory(app.path)
+        apps.append(AppResource(name=app.name, objects=objects))
+    return apps
+
+
+def load_new_node(cfg: SimonConfig) -> Optional[Node]:
+    if not cfg.new_node:
+        return None
+    objs = objects_from_directory(cfg.new_node)
+    nodes = [o for o in objs if o.get("kind") == "Node"]
+    if not nodes:
+        return None
+    # the reference supports exactly one candidate node (simon-config.yaml note)
+    node = Node.from_dict(nodes[0])
+    storage = json_files_by_stem(cfg.new_node)
+    info = storage.get(node.name)
+    if info is not None:
+        from ..core.objects import ANNO_NODE_LOCAL_STORAGE
+
+        node.meta.annotations[ANNO_NODE_LOCAL_STORAGE] = info
+    return node
+
+
+@dataclass
+class ApplyOutcome:
+    result: SimulateResult
+    plan: Optional[CapacityPlan] = None
+    report: str = ""
+
+
+def run_apply(
+    cfg: SimonConfig,
+    interactive: bool = False,
+    auto_plan: bool = True,
+    out: Optional[TextIO] = None,
+    input_fn=input,
+) -> ApplyOutcome:
+    import sys
+
+    out = out or sys.stdout
+    cluster = build_cluster(cfg)
+    apps = build_apps(cfg)
+    new_node = load_new_node(cfg)
+
+    result = simulate(cluster, apps)
+    plan: Optional[CapacityPlan] = None
+
+    if result.unscheduled and new_node is not None:
+        if interactive:
+            result = _interactive_loop(cluster, apps, new_node, result, out, input_fn)
+        elif auto_plan:
+            print(
+                f"{len(result.unscheduled)} pod(s) unschedulable; searching for "
+                f"minimum copies of node {new_node.name}...",
+                file=out,
+            )
+            plan = plan_capacity(cluster, apps, new_node)
+            if plan is None:
+                print("capacity search failed: workload does not fit", file=out)
+            else:
+                print(
+                    f"capacity plan: add {plan.nodes_added} x {new_node.name} "
+                    f"({plan.attempts} simulations)",
+                    file=out,
+                )
+                result = plan.result
+
+    report = full_report(result)
+    print(report, file=out)
+    return ApplyOutcome(result=result, plan=plan, report=report)
+
+
+def _interactive_loop(
+    cluster: ClusterResource,
+    apps,
+    new_node: Node,
+    result: SimulateResult,
+    out: TextIO,
+    input_fn,
+) -> SimulateResult:
+    """The reference's manual loop (apply.go:203-259): add one node / show
+    reasons / exit, re-simulating from scratch each iteration."""
+    added = 0
+    while result.unscheduled:
+        print(f"{len(result.unscheduled)} pod(s) failed to schedule.", file=out)
+        choice = input_fn(
+            "[a]dd a new node, show [r]easons, or [q]uit? "
+        ).strip().lower()
+        if choice.startswith("r"):
+            for u in result.unscheduled:
+                print(f"  {u.pod.key}: {u.reason}", file=out)
+            continue
+        if not choice.startswith("a"):
+            break
+        added += 1
+        trial = ClusterResource(
+            nodes=list(cluster.nodes) + new_fake_nodes(new_node, added),
+            pods=list(cluster.pods),
+            daemonsets=list(cluster.daemonsets),
+            others=dict(cluster.others),
+        )
+        result = simulate(trial, apps)
+    return result

@@ -1,0 +1,135 @@
+"""Capacity planning: the add-node search.
+
+Parity: the reference's interactive loop re-simulates from scratch after each
+manually-added node (`pkg/apply/apply.go:197-259`) and gates success on average
+utilization limits from env MaxCPU/MaxMemory/MaxVG
+(`satisfyResourceSetting`, `apply.go:689-775`).
+
+TPU-native upgrade: simulation is cheap enough to *search* — exponential probe
+then bisection on the clone count — so `plan_capacity` finds the minimum number
+of new nodes automatically instead of asking a human after every step. The
+interactive mode is kept for CLI parity.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+from ..core.objects import LABEL_NEW_NODE, Node
+from .simulator import AppResource, ClusterResource, SimulateResult, simulate
+
+def new_fake_nodes(template: Node, count: int) -> List[Node]:
+    """Clone the candidate node `count` times as simon-NNNNN with the new-node
+    label (parity: utils.NewFakeNodes, utils.go:885-915 — the reference uses
+    random 5-char suffixes; we use ordinals so names are guaranteed unique at
+    any count and identical across capacity-search probes)."""
+    out = []
+    for i in range(count):
+        node = copy.deepcopy(template)
+        node.meta.name = f"simon-{i:05d}"
+        node.meta.labels["kubernetes.io/hostname"] = node.meta.name
+        node.meta.labels[LABEL_NEW_NODE] = "true"
+        out.append(node)
+    return out
+
+
+def max_resource_limits() -> Tuple[float, float]:
+    """Env knobs MaxCPU / MaxMemory as percentages (pkg/type/const.go:29-31);
+    100 means no limit."""
+
+    def read(name: str) -> float:
+        try:
+            v = float(os.environ.get(name, "100"))
+        except ValueError:
+            return 100.0
+        return v if 0 < v <= 100 else 100.0
+
+    return read("MaxCPU"), read("MaxMemory")
+
+
+def satisfy_resource_setting(result: SimulateResult) -> bool:
+    """Cluster-average requested/allocatable must stay under MaxCPU/MaxMemory
+    (apply.go:689-775)."""
+    max_cpu, max_mem = max_resource_limits()
+    if max_cpu >= 100 and max_mem >= 100:
+        return True
+    total_cpu = total_cpu_req = total_mem = total_mem_req = 0
+    for st in result.node_status:
+        total_cpu += st.node.allocatable.get("cpu", 0)
+        total_mem += st.node.allocatable.get("memory", 0)
+        for pod in st.pods:
+            total_cpu_req += pod.requests.get("cpu", 0)
+            total_mem_req += pod.requests.get("memory", 0)
+    cpu_ok = total_cpu == 0 or (100.0 * total_cpu_req / total_cpu) <= max_cpu
+    mem_ok = total_mem == 0 or (100.0 * total_mem_req / total_mem) <= max_mem
+    return cpu_ok and mem_ok
+
+
+@dataclass
+class CapacityPlan:
+    nodes_added: int
+    result: SimulateResult
+    attempts: int
+
+
+def _probe(
+    cluster: ClusterResource,
+    apps: Sequence[AppResource],
+    template: Node,
+    k: int,
+    weights: Optional[dict],
+) -> SimulateResult:
+    trial = ClusterResource(
+        nodes=list(cluster.nodes) + new_fake_nodes(template, k),
+        pods=list(cluster.pods),
+        daemonsets=list(cluster.daemonsets),
+        others=dict(cluster.others),
+    )
+    return simulate(trial, apps, weights=weights)
+
+
+def plan_capacity(
+    cluster: ClusterResource,
+    apps: Sequence[AppResource],
+    new_node: Node,
+    max_new_nodes: int = 1 << 14,
+    weights: Optional[dict] = None,
+) -> Optional[CapacityPlan]:
+    """Minimum clones of `new_node` so every pod schedules and utilization
+    gates pass. Returns None if even max_new_nodes doesn't suffice."""
+
+    attempts = 0
+
+    def good(res: SimulateResult) -> bool:
+        return not res.unscheduled and satisfy_resource_setting(res)
+
+    base = _probe(cluster, apps, new_node, 0, weights)
+    attempts += 1
+    if good(base):
+        return CapacityPlan(0, base, attempts)
+
+    # exponential growth to bracket, then bisect
+    lo, hi = 0, 1
+    hi_result = None
+    while hi <= max_new_nodes:
+        hi_result = _probe(cluster, apps, new_node, hi, weights)
+        attempts += 1
+        if good(hi_result):
+            break
+        lo = hi
+        hi *= 2
+    else:
+        return None
+    best, best_result = hi, hi_result
+    while lo + 1 < hi:
+        mid = (lo + hi) // 2
+        res = _probe(cluster, apps, new_node, mid, weights)
+        attempts += 1
+        if good(res):
+            hi, best, best_result = mid, mid, res
+        else:
+            lo = mid
+    return CapacityPlan(best, best_result, attempts)

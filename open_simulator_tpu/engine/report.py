@@ -1,0 +1,117 @@
+"""Simulation reports: cluster / node / app views.
+
+Parity: the pterm report tables in `/root/reference/pkg/apply/apply.go:308-687`
+(reportClusterInfo, reportNodeInfo, reportApp*): per-node requested vs
+allocatable cpu/mem with percentages, pod counts, new-node marking, pod→node
+placements grouped by workload, and unscheduled pods with reasons.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..core.objects import (
+    ANNO_WORKLOAD_KIND,
+    ANNO_WORKLOAD_NAME,
+    LABEL_NEW_NODE,
+    Node,
+    Pod,
+)
+from ..utils.quantity import format_bytes, format_milli
+from ..utils.tables import render_table
+from .simulator import SimulateResult
+
+
+def _pct(used: float, total: float) -> str:
+    if total <= 0:
+        return "-"
+    return f"{100.0 * used / total:.1f}%"
+
+
+def cluster_report(result: SimulateResult) -> str:
+    headers = [
+        "Node", "CPU Alloc", "CPU Req", "CPU%", "Mem Alloc", "Mem Req", "Mem%",
+        "Pods", "PodCap", "New",
+    ]
+    rows = []
+    total_cpu = total_cpu_req = 0
+    total_mem = total_mem_req = 0
+    for st in result.node_status:
+        node = st.node
+        cpu_alloc = node.allocatable.get("cpu", 0)
+        mem_alloc = node.allocatable.get("memory", 0)
+        cpu_req = sum(p.requests.get("cpu", 0) for p in st.pods)
+        mem_req = sum(p.requests.get("memory", 0) for p in st.pods)
+        total_cpu += cpu_alloc
+        total_cpu_req += cpu_req
+        total_mem += mem_alloc
+        total_mem_req += mem_req
+        rows.append(
+            [
+                node.name,
+                format_milli(cpu_alloc),
+                format_milli(cpu_req),
+                _pct(cpu_req, cpu_alloc),
+                format_bytes(mem_alloc),
+                format_bytes(mem_req),
+                _pct(mem_req, mem_alloc),
+                len(st.pods),
+                node.allocatable.get("pods", 0),
+                "yes" if LABEL_NEW_NODE in node.meta.labels else "",
+            ]
+        )
+    rows.append(
+        [
+            "(total)",
+            format_milli(total_cpu),
+            format_milli(total_cpu_req),
+            _pct(total_cpu_req, total_cpu),
+            format_bytes(total_mem),
+            format_bytes(total_mem_req),
+            _pct(total_mem_req, total_mem),
+            sum(len(st.pods) for st in result.node_status),
+            "",
+            "",
+        ]
+    )
+    return render_table(headers, rows)
+
+
+def placement_report(result: SimulateResult) -> str:
+    headers = ["Node", "Pod", "Workload", "CPU Req", "Mem Req"]
+    rows = []
+    for st in sorted(result.node_status, key=lambda s: s.node.name):
+        for pod in sorted(st.pods, key=lambda p: p.key):
+            kind = pod.meta.annotations.get(ANNO_WORKLOAD_KIND, "Pod")
+            name = pod.meta.annotations.get(ANNO_WORKLOAD_NAME, "")
+            rows.append(
+                [
+                    st.node.name,
+                    pod.key,
+                    f"{kind}/{name}" if name else kind,
+                    format_milli(pod.requests.get("cpu", 0)),
+                    format_bytes(pod.requests.get("memory", 0)),
+                ]
+            )
+    return render_table(headers, rows)
+
+
+def unscheduled_report(result: SimulateResult) -> str:
+    if not result.unscheduled:
+        return "All pods scheduled."
+    headers = ["Pod", "Reason"]
+    rows = [[u.pod.key, u.reason] for u in result.unscheduled]
+    return render_table(headers, rows)
+
+
+def full_report(result: SimulateResult) -> str:
+    return "\n\n".join(
+        [
+            "=== Cluster ===",
+            cluster_report(result),
+            "=== Placements ===",
+            placement_report(result),
+            "=== Unscheduled ===",
+            unscheduled_report(result),
+        ]
+    )

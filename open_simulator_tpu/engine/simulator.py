@@ -18,6 +18,7 @@ tolerations first, then pods with node selectors.
 
 from __future__ import annotations
 
+import copy
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -37,18 +38,17 @@ from ..ops.encode import (
     encode_pods,
     initial_selector_counts,
 )
+from ..ops.chunked import schedule_batch_chunked
 from ..ops.kernels import (
     FILTER_MESSAGES,
     NUM_FILTERS,
     DEFAULT_WEIGHTS,
-    schedule_batch,
     weights_array,
 )
 from ..ops.state import (
     align_sel_counts,
     carry_from_table,
     node_static_from_table,
-    pod_rows_from_batch,
 )
 
 
@@ -152,7 +152,10 @@ class Simulator:
             if pod.node_name:
                 self._bound.append((pod, pod.node_name))
             elif pod.scheduler_name == DEFAULT_SCHEDULER:
-                self._pending_cluster.append(pod)
+                # Copy: scheduling mutates node_name/phase, and the caller's
+                # cluster must stay pristine for re-simulation (the capacity
+                # search probes the same ClusterResource many times).
+                self._pending_cluster.append(copy.deepcopy(pod))
         # Cluster daemonsets expand against the final node list (core.go:85-96).
         for ds in cluster.daemonsets:
             self._pending_cluster.extend(pods_from_workload(ds, nodes=cluster.nodes))
@@ -181,12 +184,9 @@ class Simulator:
             return []
         batch = encode_pods(self.enc, pods)
         self._carry = align_sel_counts(self._carry, len(self.enc.selectors))
-        rows = pod_rows_from_batch(batch)
-        self._carry, placed, reasons = schedule_batch(
-            self._ns, self._carry, rows, self.weights
+        self._carry, placed_np, reasons_np = schedule_batch_chunked(
+            self._ns, self._carry, batch, self.weights
         )
-        placed_np = np.asarray(placed)
-        reasons_np = np.asarray(reasons)
         failed: List[UnscheduledPod] = []
         n_nodes = len(self.cluster.nodes)
         for i, pod in enumerate(pods):

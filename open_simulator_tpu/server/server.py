@@ -1,0 +1,140 @@
+"""REST simulation service.
+
+Parity: `/root/reference/pkg/server/server.go` — gin routes
+  POST /api/deploy-apps   simulate deploying workloads onto a cluster snapshot
+  POST /api/scale-apps    remove a workload's pods, re-simulate at new counts
+  GET  /healthz           liveness
+with the reference's TryLock busy-rejection (503 while a simulation runs).
+
+The reference snapshots a live cluster through informers; this environment has
+no cluster, so snapshots arrive in the request body (or from a manifest
+directory on disk) — the simulation semantics are identical. Request schema:
+
+  {
+    "cluster": {"objects": [...k8s objects...]} | {"path": "dir"},
+    "apps":    [{"name": "a", "objects": [...]}],
+    "newNodes": [...Node objects...],            # optional
+    "removeWorkloads": [{"kind": "Deployment", "name": "x", "namespace": "d"}]
+  }
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from ..core.objects import (
+    ANNO_WORKLOAD_KIND,
+    ANNO_WORKLOAD_NAME,
+    ANNO_WORKLOAD_NAMESPACE,
+    Node,
+)
+from ..engine.simulator import AppResource, ClusterResource, simulate
+from ..utils.yamlio import objects_from_directory
+
+_busy = threading.Lock()
+
+
+def _simulate_request(body: dict) -> dict:
+    cluster_spec = body.get("cluster") or {}
+    if "path" in cluster_spec:
+        objs = objects_from_directory(cluster_spec["path"])
+    else:
+        objs = list(cluster_spec.get("objects") or [])
+    cluster = ClusterResource.from_objects(objs)
+    for nd in body.get("newNodes") or []:
+        cluster.nodes.append(Node.from_dict(nd))
+
+    # scale-apps: drop pods owned by the named workloads before re-simulating
+    # (parity: removePodsOfApp, server.go:404-444)
+    removals = {
+        (w.get("kind", ""), w.get("namespace", "default"), w.get("name", ""))
+        for w in body.get("removeWorkloads") or []
+    }
+    if removals:
+        def owned(pod) -> bool:
+            ann = pod.meta.annotations
+            key = (
+                ann.get(ANNO_WORKLOAD_KIND, pod.meta.owner_kind),
+                ann.get(ANNO_WORKLOAD_NAMESPACE, pod.meta.namespace),
+                ann.get(ANNO_WORKLOAD_NAME, pod.meta.owner_name),
+            )
+            return key in removals
+
+        cluster.pods = [p for p in cluster.pods if not owned(p)]
+
+    apps = [
+        AppResource(name=a.get("name", f"app-{i}"), objects=list(a.get("objects") or []))
+        for i, a in enumerate(body.get("apps") or [])
+    ]
+    result = simulate(cluster, apps)
+    placements = {}
+    for st in result.node_status:
+        for pod in st.pods:
+            placements[pod.key] = st.node.name
+    return {
+        "placements": placements,
+        "unscheduled": [
+            {"pod": u.pod.key, "reason": u.reason} for u in result.unscheduled
+        ],
+    }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def _send(self, code: int, payload: dict) -> None:
+        data = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802
+        if self.path == "/healthz":
+            self._send(200, {"status": "ok"})
+        else:
+            self._send(404, {"error": "not found"})
+
+    def do_POST(self):  # noqa: N802
+        if self.path not in ("/api/deploy-apps", "/api/scale-apps"):
+            self._send(404, {"error": "not found"})
+            return
+        if not _busy.acquire(blocking=False):
+            self._send(503, {"error": "simulation in progress, try again later"})
+            return
+        # Release BEFORE sending: once the client has the response it may fire
+        # the next request immediately, and a send-then-release order loses
+        # that race and bounces it with a spurious 503.
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            code, payload = 200, _simulate_request(body)
+        except Exception as e:  # surface simulation errors as 400s
+            code, payload = 400, {"error": str(e)}
+        finally:
+            _busy.release()
+        self._send(code, payload)
+
+    def log_message(self, fmt, *args):  # quiet gin-style access logs
+        pass
+
+
+def serve(port: int = 9998, ready: Optional[threading.Event] = None) -> int:
+    httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+    if ready is not None:
+        ready.set()
+    print(f"simon server listening on 127.0.0.1:{port}")
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+    return 0
+
+
+def make_server(port: int = 0):
+    """Embeddable server for tests; returns the ThreadingHTTPServer."""
+    return ThreadingHTTPServer(("127.0.0.1", port), _Handler)

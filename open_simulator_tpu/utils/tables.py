@@ -1,0 +1,24 @@
+"""Minimal text-table rendering (replaces the reference's pterm tables)."""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+
+def render_table(headers: Sequence[str], rows: Sequence[Sequence[str]]) -> str:
+    cols = len(headers)
+    widths = [len(str(h)) for h in headers]
+    str_rows: List[List[str]] = []
+    for row in rows:
+        cells = [str(c) for c in row] + [""] * (cols - len(row))
+        str_rows.append(cells)
+        for i in range(cols):
+            widths[i] = max(widths[i], len(cells[i]))
+
+    def line(cells: Sequence[str]) -> str:
+        return "| " + " | ".join(c.ljust(widths[i]) for i, c in enumerate(cells)) + " |"
+
+    sep = "|" + "|".join("-" * (w + 2) for w in widths) + "|"
+    out = [line([str(h) for h in headers]), sep]
+    out.extend(line(r) for r in str_rows)
+    return "\n".join(out)

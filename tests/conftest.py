@@ -2,14 +2,21 @@
 
 Multi-chip TPU hardware is not available in CI; sharding correctness is
 validated on 8 virtual CPU devices exactly as the driver's dryrun does.
+The environment presets JAX_PLATFORMS=axon (the TPU tunnel) and merges it
+back in, so setting the env var alone is not enough — jax.config.update is
+authoritative and must run before any computation.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,199 @@
+"""End-to-end tests: config → cluster/apps → simulate → capacity → report/CLI/server.
+
+Modeled on the reference's integration test strategy
+(`pkg/simulator/core_test.go`): a multi-node cluster with taints + a cluster
+DaemonSet, an app covering several workload kinds, and a workload-conservation
+oracle over the results.
+"""
+
+import io
+import json
+import os
+import threading
+import urllib.request
+
+import pytest
+
+from open_simulator_tpu.api.config import SimonConfig
+from open_simulator_tpu.core.workloads import expected_pod_counts
+from open_simulator_tpu.engine.apply import build_apps, build_cluster, load_new_node, run_apply
+from open_simulator_tpu.engine.capacity import plan_capacity
+from open_simulator_tpu.engine.simulator import simulate
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+CONFIG = os.path.join(FIXTURES, "simon-config.yaml")
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return SimonConfig.load(CONFIG)
+
+
+def test_config_load(cfg):
+    assert cfg.custom_config.endswith("cluster")
+    assert cfg.app_list[0].name == "shop"
+    assert cfg.new_node.endswith("newnode")
+
+
+def test_simulate_conservation_and_placement(cfg):
+    cluster = build_cluster(cfg)
+    apps = build_apps(cfg)
+    result = simulate(cluster, apps)
+
+    # DaemonSet tolerates everything -> one agent pod per node
+    agent_nodes = {
+        st.node.name
+        for st in result.node_status
+        for p in st.pods
+        if p.meta.annotations.get("simon/workload-name") == "node-agent"
+    }
+    assert agent_nodes == {"cp-1", "w-1", "w-2"}
+
+    # workload conservation: scheduled + unscheduled == expected
+    expected = expected_pod_counts(
+        [o for a in apps for o in a.objects] + cluster.daemonsets, cluster.nodes
+    )
+    placed = sum(len(st.pods) for st in result.node_status)
+    assert placed + len(result.unscheduled) == sum(expected.values())
+
+    # anti-affinity cache pods on distinct nodes
+    cache_nodes = [
+        st.node.name
+        for st in result.node_status
+        for p in st.pods
+        if p.meta.annotations.get("simon/workload-name") == "cache"
+    ]
+    assert len(cache_nodes) == len(set(cache_nodes)) == 2
+
+    # control-plane taint respected: only the (tolerating) agent runs there
+    cp_pods = result.pods_on("cp-1")
+    assert all(
+        p.meta.annotations.get("simon/workload-name") == "node-agent" for p in cp_pods
+    )
+
+    # 4 web replicas want 2cpu each; workers have 8cpu each minus agents/cache
+    assert not result.unscheduled
+
+
+def test_capacity_plan_when_overloaded(cfg):
+    cluster = build_cluster(cfg)
+    apps = build_apps(cfg)
+    # quadruple the web deployment so it cannot fit
+    for app in apps:
+        for obj in app.objects:
+            if obj.get("kind") == "Deployment":
+                obj["spec"]["replicas"] = 20
+    result = simulate(cluster, apps)
+    assert result.unscheduled
+
+    new_node = load_new_node(cfg)
+    plan = plan_capacity(cluster, apps, new_node)
+    assert plan is not None
+    assert plan.nodes_added >= 1
+    assert not plan.result.unscheduled
+    # minimality: one fewer node must not suffice
+    if plan.nodes_added > 1:
+        from open_simulator_tpu.engine.capacity import _probe
+
+        worse = _probe(cluster, apps, new_node, plan.nodes_added - 1, None)
+        assert worse.unscheduled
+
+
+def test_run_apply_report(cfg):
+    out = io.StringIO()
+    outcome = run_apply(cfg, out=out)
+    text = out.getvalue()
+    assert "=== Cluster ===" in text
+    assert "cp-1" in text and "w-1" in text and "w-2" in text
+    assert "All pods scheduled." in text
+    assert not outcome.result.unscheduled
+
+
+def test_cli_apply(tmp_path, capsys):
+    from open_simulator_tpu.cli.main import main
+
+    report = tmp_path / "report.txt"
+    rc = main(["apply", "-f", CONFIG, "--output-file", str(report)])
+    assert rc == 0
+    assert "=== Unscheduled ===" in report.read_text()
+
+    rc = main(["version"])
+    assert rc == 0
+    assert "simon-tpu version" in capsys.readouterr().out
+
+    rc = main(["apply", "-f", str(tmp_path / "missing.yaml")])
+    assert rc == 1
+
+
+def test_interactive_loop(cfg):
+    from open_simulator_tpu.engine.apply import _interactive_loop
+
+    cluster = build_cluster(cfg)
+    apps = build_apps(cfg)
+    for app in apps:
+        for obj in app.objects:
+            if obj.get("kind") == "Deployment":
+                obj["spec"]["replicas"] = 12
+    result = simulate(cluster, apps)
+    assert result.unscheduled
+    new_node = load_new_node(cfg)
+    out = io.StringIO()
+    answers = iter(["r"] + ["a"] * 10 + ["q"])
+    final = _interactive_loop(
+        cluster, apps, new_node, result, out, lambda _: next(answers)
+    )
+    text = out.getvalue()
+    assert "failed to schedule" in text
+    assert f"{result.unscheduled[0].pod.key}:" in text  # [r]easons path
+    assert not final.unscheduled  # enough added nodes resolves it
+
+
+def test_server_roundtrip(cfg):
+    from open_simulator_tpu.server.server import make_server
+
+    srv = make_server(0)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz") as r:
+            assert json.load(r)["status"] == "ok"
+
+        cluster_objs = []
+        import yaml
+
+        from open_simulator_tpu.utils.yamlio import walk_files
+
+        for f in walk_files(os.path.join(FIXTURES, "cluster"), (".yaml", ".yml")):
+            cluster_objs.extend(d for d in yaml.safe_load_all(open(f)) if d)
+        app_objs = []
+        for f in walk_files(os.path.join(FIXTURES, "app"), (".yaml", ".yml")):
+            app_objs.extend(d for d in yaml.safe_load_all(open(f)) if d)
+
+        body = json.dumps(
+            {
+                "cluster": {"objects": cluster_objs},
+                "apps": [{"name": "shop", "objects": app_objs}],
+            }
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/deploy-apps",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as r:
+            payload = json.load(r)
+        assert payload["unscheduled"] == []
+        assert len(payload["placements"]) >= 11  # 3 agents + 4 web + 2 cache + 2 job
+
+        bad = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/deploy-apps", data=b"{not-json",
+        )
+        try:
+            urllib.request.urlopen(bad)
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        srv.shutdown()
+        srv.server_close()

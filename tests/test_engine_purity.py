@@ -1,0 +1,64 @@
+"""Regression tests: simulate() must not mutate its inputs — the capacity
+search re-probes the same ClusterResource many times (code-review finding:
+pending cluster pods were bound in place, corrupting later probes)."""
+
+import yaml
+
+from open_simulator_tpu.core.objects import Node, Pod
+from open_simulator_tpu.engine.capacity import new_fake_nodes
+from open_simulator_tpu.engine.simulator import AppResource, ClusterResource, simulate
+
+
+def _cluster():
+    node = Node.from_dict(
+        {
+            "metadata": {"name": "n1", "labels": {"kubernetes.io/hostname": "n1"}},
+            "status": {"allocatable": {"cpu": "4", "memory": "8Gi", "pods": "110"}},
+        }
+    )
+    pending = Pod.from_dict(
+        {
+            "metadata": {"name": "pending", "namespace": "d"},
+            "spec": {
+                "containers": [
+                    {"name": "c", "resources": {"requests": {"cpu": "1", "memory": "1Gi"}}}
+                ]
+            },
+        }
+    )
+    return ClusterResource(nodes=[node], pods=[pending])
+
+
+def test_simulate_does_not_mutate_cluster_pods():
+    cluster = _cluster()
+    r1 = simulate(cluster, [])
+    assert cluster.pods[0].node_name == ""          # caller's pod untouched
+    assert cluster.pods[0].phase == "Pending"
+    r2 = simulate(cluster, [])                      # identical re-run
+    assert not r1.unscheduled and not r2.unscheduled
+    assert [len(s.pods) for s in r1.node_status] == [len(s.pods) for s in r2.node_status]
+
+
+def test_fake_node_names_unique_and_stable():
+    template = _cluster().nodes[0]
+    a = new_fake_nodes(template, 1000)
+    names = [n.meta.name for n in a]
+    assert len(set(names)) == 1000
+    b = new_fake_nodes(template, 1000)
+    assert names == [n.meta.name for n in b]        # probe-independent
+
+
+def test_negative_gpu_count_annotation_rejected():
+    pod = Pod.from_dict(
+        {
+            "metadata": {
+                "name": "g",
+                "annotations": {
+                    "alibabacloud.com/gpu-count": "-2",
+                    "alibabacloud.com/gpu-mem": "4",
+                },
+            },
+            "spec": {"containers": []},
+        }
+    )
+    assert pod.gpu_count_request() == 1  # falls back to gpu-mem>0 => 1
