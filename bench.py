@@ -150,7 +150,7 @@ def main() -> int:
 
     import jax
 
-    from open_simulator_tpu.ops.chunked import schedule_batch_chunked
+    from open_simulator_tpu.ops.grouped import schedule_batch_grouped
     from open_simulator_tpu.ops.kernels import weights_array
 
     t_enc0 = time.time()
@@ -159,14 +159,15 @@ def main() -> int:
     w = weights_array()
 
     # Warm up with one full untimed pass (same shapes => same executables),
-    # then one timed pass. Chunked execution bounds each device program to a
-    # few seconds (a single 100k-step scan trips the TPU worker's watchdog).
+    # then one timed pass. The grouped scheduler's per-group chunking
+    # (schedule_batch_grouped max_group_chunk) bounds each device program to a
+    # few seconds — a single 100k-step scan trips the TPU worker's watchdog.
     t0 = time.time()
-    schedule_batch_chunked(ns, carry, batch, w)
+    schedule_batch_grouped(ns, carry, batch, w)
     compile_s = time.time() - t0
 
     t1 = time.time()
-    _, placed, _ = schedule_batch_chunked(ns, carry, batch, w)
+    _, placed, _ = schedule_batch_grouped(ns, carry, batch, w)
     run = time.time() - t1
     scheduled = int((placed >= 0).sum())
     pods_per_sec = args.pods / run

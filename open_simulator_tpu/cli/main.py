@@ -21,6 +21,10 @@ VERSION = "0.1.0"
 def _add_apply(sub: argparse._SubParsersAction) -> None:
     p = sub.add_parser("apply", help="simulate deploying applications")
     p.add_argument("-f", "--simon-config", required=True, help="path of simon config")
+    p.add_argument(
+        "--default-scheduler-config", default="",
+        help="KubeSchedulerConfiguration YAML merged with simon's plugin set",
+    )
     p.add_argument("--output-file", default="", help="write the report to a file")
     p.add_argument(
         "-i", "--interactive", action="store_true",
@@ -79,6 +83,7 @@ def main(argv=None) -> int:
                     interactive=args.interactive,
                     auto_plan=not args.no_auto_plan,
                     out=out,
+                    scheduler_config=args.default_scheduler_config,
                 )
             finally:
                 if out is not None:

@@ -110,27 +110,33 @@ def run_apply(
     auto_plan: bool = True,
     out: Optional[TextIO] = None,
     input_fn=input,
+    scheduler_config: str = "",
 ) -> ApplyOutcome:
     import sys
+
+    from ..models.profiles import load_scheduler_config
 
     out = out or sys.stdout
     cluster = build_cluster(cfg)
     apps = build_apps(cfg)
     new_node = load_new_node(cfg)
+    weights = load_scheduler_config(scheduler_config).weights
 
-    result = simulate(cluster, apps)
+    result = simulate(cluster, apps, weights=weights)
     plan: Optional[CapacityPlan] = None
 
     if result.unscheduled and new_node is not None:
         if interactive:
-            result = _interactive_loop(cluster, apps, new_node, result, out, input_fn)
+            result = _interactive_loop(
+                cluster, apps, new_node, result, out, input_fn, weights=weights
+            )
         elif auto_plan:
             print(
                 f"{len(result.unscheduled)} pod(s) unschedulable; searching for "
                 f"minimum copies of node {new_node.name}...",
                 file=out,
             )
-            plan = plan_capacity(cluster, apps, new_node)
+            plan = plan_capacity(cluster, apps, new_node, weights=weights)
             if plan is None:
                 print("capacity search failed: workload does not fit", file=out)
             else:
@@ -153,6 +159,7 @@ def _interactive_loop(
     result: SimulateResult,
     out: TextIO,
     input_fn,
+    weights=None,
 ) -> SimulateResult:
     """The reference's manual loop (apply.go:203-259): add one node / show
     reasons / exit, re-simulating from scratch each iteration."""
@@ -175,5 +182,5 @@ def _interactive_loop(
             daemonsets=list(cluster.daemonsets),
             others=dict(cluster.others),
         )
-        result = simulate(trial, apps)
+        result = simulate(trial, apps, weights=weights)
     return result

@@ -38,7 +38,7 @@ from ..ops.encode import (
     encode_pods,
     initial_selector_counts,
 )
-from ..ops.chunked import schedule_batch_chunked
+from ..ops.grouped import schedule_batch_grouped
 from ..ops.kernels import (
     FILTER_MESSAGES,
     NUM_FILTERS,
@@ -184,7 +184,9 @@ class Simulator:
             return []
         batch = encode_pods(self.enc, pods)
         self._carry = align_sel_counts(self._carry, len(self.enc.selectors))
-        self._carry, placed_np, reasons_np = schedule_batch_chunked(
+        # Grouped path: identical results to the naive scan, but static
+        # filter/score work is hoisted per run of identical pods.
+        self._carry, placed_np, reasons_np = schedule_batch_grouped(
             self._ns, self._carry, batch, self.weights
         )
         failed: List[UnscheduledPod] = []

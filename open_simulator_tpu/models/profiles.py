@@ -1,0 +1,104 @@
+"""Scheduler profiles: which score plugins run, at what weight.
+
+Parity: the reference assembles a KubeSchedulerConfiguration programmatically —
+default provider plugins + Simon/Open-Local/Open-Gpu-Share injected, DefaultBinder
+disabled, PercentageOfNodesToScore pinned to 100
+(`/root/reference/pkg/simulator/utils.go:304-381`) — optionally merged with a
+user-supplied scheduler config file (`--default-scheduler-config`,
+`cmd/apply/apply.go:28`).
+
+Here a profile is the weight vector handed to the score kernels; filters always
+run (matching the default provider's filter set). Kube plugin names map to
+kernel names so user config files written for the reference keep working.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+import yaml
+
+from ..ops.kernels import DEFAULT_WEIGHTS
+
+# kube plugin name -> kernel score name
+PLUGIN_NAME_MAP = {
+    "NodeResourcesLeastAllocated": "least_allocated",
+    "NodeResourcesBalancedAllocation": "balanced_allocation",
+    "NodeAffinity": "node_affinity",
+    "TaintToleration": "taint_toleration",
+    "PodTopologySpread": "topology_spread",
+    "InterPodAffinity": "inter_pod_affinity",
+    "NodePreferAvoidPods": "prefer_avoid_pods",
+    "Simon": "simon",
+    # score-neutral in a fake cluster (no images, see SURVEY §2.2): accepted
+    # and ignored so reference configs parse cleanly
+    "ImageLocality": None,
+    "NodeResourcesMostAllocated": None,
+    "RequestedToCapacityRatio": None,
+    "SelectorSpread": None,
+    "DefaultPodTopologySpread": None,
+}
+
+
+@dataclass
+class SchedulerProfile:
+    scheduler_name: str = "default-scheduler"
+    weights: Dict[str, float] = field(default_factory=lambda: dict(DEFAULT_WEIGHTS))
+    percentage_of_nodes_to_score: int = 100  # simon pins 100 (utils.go:370)
+
+    def with_plugin(self, kube_name: str, weight: float = 1.0) -> "SchedulerProfile":
+        kernel = PLUGIN_NAME_MAP.get(kube_name)
+        if kernel:
+            self.weights[kernel] = weight
+        return self
+
+    def without_plugin(self, kube_name: str) -> "SchedulerProfile":
+        kernel = PLUGIN_NAME_MAP.get(kube_name)
+        if kernel:
+            self.weights[kernel] = 0.0
+        return self
+
+
+def default_profile() -> SchedulerProfile:
+    """Default provider score weights + Simon at 1 (utils.go:304-368 plus
+    algorithmprovider/registry.go:71-148)."""
+    return SchedulerProfile()
+
+
+def load_scheduler_config(path: Optional[str]) -> SchedulerProfile:
+    """Merge a KubeSchedulerConfiguration YAML into the simon defaults.
+
+    Mirrors InitKubeSchedulerConfiguration: the user file's profile[0] score
+    plugin enable/disable list adjusts weights; simon's own plugins stay
+    enabled regardless (the reference injects them after merging)."""
+    profile = default_profile()
+    if not path:
+        return profile
+    with open(path, "r") as fh:
+        doc = yaml.safe_load(fh) or {}
+    kind = doc.get("kind", "")
+    if kind and kind != "KubeSchedulerConfiguration":
+        raise ValueError(f"{path}: expected KubeSchedulerConfiguration, got {kind}")
+    profiles = doc.get("profiles") or [{}]
+    p0 = profiles[0] or {}
+    if p0.get("schedulerName"):
+        profile.scheduler_name = p0["schedulerName"]
+    plugins = p0.get("plugins") or {}
+    score = plugins.get("score") or {}
+    for item in score.get("disabled") or []:
+        name = item.get("name", "")
+        if name == "*":
+            for k in list(profile.weights):
+                if k != "simon":  # simon is re-injected unconditionally
+                    profile.weights[k] = 0.0
+        else:
+            profile.without_plugin(name)
+    for item in score.get("enabled") or []:
+        profile.with_plugin(item.get("name", ""), float(item.get("weight") or 1))
+    pct = doc.get("percentageOfNodesToScore")
+    if pct:
+        # accepted for config-compat; the TPU engine always scores all nodes
+        # (simon pins 100 anyway)
+        profile.percentage_of_nodes_to_score = int(pct)
+    return profile

@@ -113,9 +113,13 @@ class Encoder:
         self.pairs = Vocab()       # "key=value"
         self.names = Vocab()       # node names
         self.resources: List[str] = ["cpu", "memory", "pods"]
-        self.topology_keys: List[str] = list(
-            dict.fromkeys(list(topology_keys))
-        )
+        # kubernetes.io/hostname is pinned at index 0: its domains are the
+        # nodes themselves, handled natively by the kernels (a dense one-hot
+        # for it would be O(N^2) memory — kernels.HOSTNAME_KEY_IDX).
+        self.topology_keys: List[str] = ["kubernetes.io/hostname"] + [
+            k for k in dict.fromkeys(list(topology_keys))
+            if k != "kubernetes.io/hostname"
+        ]
         self.selectors: List[SelectorEntry] = []
         self._selector_ids: Dict[Tuple, int] = {}
         self.domains = Vocab()     # "topokey=value" domain ids
@@ -310,7 +314,8 @@ def encode_nodes(
             taint_key[i, j] = enc.keys.id(t.key)
             taint_val[i, j] = enc.vals.id(t.value)
             taint_effect[i, j] = _EFFECTS.get(t.effect, 0)
-        for k_idx, key in enumerate(enc.topology_keys):
+        topo[i, 0] = i  # hostname: every node is its own domain
+        for k_idx, key in enumerate(enc.topology_keys[1:], start=1):
             v = nd.meta.labels.get(key)
             if v is not None:
                 topo[i, k_idx] = enc.domain_id(k_idx, key, v)

@@ -92,6 +92,7 @@ class NodeStatic(NamedTuple):
     topo: jnp.ndarray         # i32[N,K] domain id or -1
     valid: jnp.ndarray        # bool[N]
     domain_key: jnp.ndarray   # i32[D] topo-key index per domain id (-1 pad)
+    topo_onehot: jnp.ndarray  # f32[K,D,N] domain membership (0 for missing key)
     unsched_key_id: jnp.ndarray  # i32 scalar: key id of node.kubernetes.io/unschedulable
     empty_val_id: jnp.ndarray    # i32 scalar: value id of ""
 
@@ -206,14 +207,46 @@ def taint_mask(ns: NodeStatic, pod: PodRow) -> jnp.ndarray:
     return jnp.all(tolerated | ~hard, axis=1)
 
 
-def _domain_counts(ns: NodeStatic, counts_node: jnp.ndarray, topo_k: jnp.ndarray) -> jnp.ndarray:
-    """Scatter per-node counts into per-domain sums. counts_node f32[N],
-    topo_k i32[N] (domain id or -1) -> f32[D+1] (last slot = dropped)."""
-    D = ns.domain_key.shape[0]
-    idx = jnp.where(topo_k >= 0, topo_k, D)
-    return jnp.zeros(D + 1, jnp.float32).at[idx].add(
-        jnp.where(ns.valid, counts_node, 0.0)
+HOSTNAME_KEY_IDX = 0  # Encoder pins kubernetes.io/hostname at topo index 0
+
+
+def _domain_counts(ns: NodeStatic, counts_node: jnp.ndarray, k: jnp.ndarray):
+    """Per-domain sums + their per-node broadcast for topology key k.
+
+    Two representations (TPU scatters serialize, so neither path scatters):
+      - hostname (k==0): domains ≡ nodes 1:1, so the per-node count IS the
+        input and no [D,N] matrix is ever materialized (a dense one-hot for
+        hostname would be O(N²) memory).
+      - low-cardinality keys (zone/region/...): matvec against the precomputed
+        one-hot membership (f32-exact precision — bf16 MXU rounding would
+        corrupt integer counts above 256), then an exact gather back to nodes.
+
+    Returns (dom f32[D] — hostname slot returns zeros, use the host outputs —,
+    cnt_n f32[N], min_count f32, total f32) where min_count is the minimum
+    count over existing domains of key k and total the sum over them."""
+    counts = jnp.where(ns.valid, counts_node, 0.0)
+    is_host = k == HOSTNAME_KEY_IDX
+
+    onehot = ns.topo_onehot[k]                                  # [D,N]
+    dom = jax.lax.dot_general(
+        onehot, counts, (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+    )                                                           # [D]
+    topo_k = ns.topo[:, k]
+    D = dom.shape[0]
+    cnt_gather = jnp.where(
+        topo_k >= 0, dom[jnp.clip(topo_k, 0, D - 1)], 0.0
     )
+    cnt_n = jnp.where(is_host, counts, cnt_gather)
+
+    in_key = ns.domain_key == k                                 # [D]
+    min_dom = jnp.min(jnp.where(in_key, dom, jnp.inf))
+    min_host = jnp.min(jnp.where(ns.valid, counts_node, jnp.inf))
+    min_count = jnp.where(is_host, min_host, min_dom)
+    min_count = jnp.where(jnp.isfinite(min_count), min_count, 0.0)
+
+    total = jnp.where(is_host, jnp.sum(counts), jnp.sum(jnp.where(in_key, dom, 0.0)))
+    return dom, cnt_n, min_count, total
 
 
 def spread_mask(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
@@ -227,17 +260,10 @@ def spread_mask(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
     def one(topo_idx, sel_idx, max_skew, hard):
         active = (topo_idx >= 0) & hard
         k = jnp.maximum(topo_idx, 0)
-        topo_k = ns.topo[:, k]                                  # [N]
-        counts_node = carry.sel_counts[sel_idx]                 # [N]
-        dom = _domain_counts(ns, counts_node, topo_k)           # [D+1]
-        in_key = ns.domain_key == k                             # [D]
-        min_count = jnp.min(
-            jnp.where(in_key, dom[:-1], jnp.inf)
-        )
-        min_count = jnp.where(jnp.isfinite(min_count), min_count, 0.0)
-        candidate = jnp.where(topo_k >= 0, dom[jnp.maximum(topo_k, 0)], jnp.inf)
-        ok = (candidate + 1.0 - min_count) <= max_skew + _EPS
-        ok = ok & (topo_k >= 0)
+        has_key = ns.topo[:, k] >= 0                            # [N]
+        _, cnt_n, min_count, _ = _domain_counts(ns, carry.sel_counts[sel_idx], k)
+        ok = (cnt_n + 1.0 - min_count) <= max_skew + _EPS
+        ok = ok & has_key
         return jnp.where(active, ok, jnp.ones_like(ok))
 
     per_c = jax.vmap(one, in_axes=(0, 0, 0, 0), out_axes=1)(
@@ -260,14 +286,11 @@ def pod_affinity_mask(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
     def one(topo_idx, sel_idx, anti, required):
         active = (topo_idx >= 0) & required
         k = jnp.maximum(topo_idx, 0)
-        topo_k = ns.topo[:, k]
-        counts_node = carry.sel_counts[sel_idx]
-        dom = _domain_counts(ns, counts_node, topo_k)
-        cnt = jnp.where(topo_k >= 0, dom[jnp.maximum(topo_k, 0)], 0.0)   # [N]
-        total = jnp.sum(dom[:-1])
+        has_key = ns.topo[:, k] >= 0
+        _, cnt, _, total = _domain_counts(ns, carry.sel_counts[sel_idx], k)
         self_match = pod.match_sel[sel_idx]
         aff_ok = (cnt > 0) | (self_match & (total == 0))
-        aff_ok = aff_ok & (topo_k >= 0)
+        aff_ok = aff_ok & has_key
         anti_ok = cnt == 0
         ok = jnp.where(anti, anti_ok, aff_ok)
         return jnp.where(active, ok, jnp.ones(ns.valid.shape, bool))
@@ -399,9 +422,7 @@ def score_topology_spread(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndar
     def one(topo_idx, sel_idx, hard):
         active = (topo_idx >= 0) & ~hard
         k = jnp.maximum(topo_idx, 0)
-        topo_k = ns.topo[:, k]
-        dom = _domain_counts(ns, carry.sel_counts[sel_idx], topo_k)
-        cnt = jnp.where(topo_k >= 0, dom[jnp.maximum(topo_k, 0)], 0.0)
+        _, cnt, _, _ = _domain_counts(ns, carry.sel_counts[sel_idx], k)
         return jnp.where(active, cnt, 0.0)
 
     raw = jnp.sum(
@@ -421,9 +442,7 @@ def score_inter_pod_affinity(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.n
     def one(topo_idx, sel_idx, anti, required, weight):
         active = (topo_idx >= 0) & ~required
         k = jnp.maximum(topo_idx, 0)
-        topo_k = ns.topo[:, k]
-        dom = _domain_counts(ns, carry.sel_counts[sel_idx], topo_k)
-        cnt = jnp.where(topo_k >= 0, dom[jnp.maximum(topo_k, 0)], 0.0)
+        _, cnt, _, _ = _domain_counts(ns, carry.sel_counts[sel_idx], k)
         signed = jnp.where(anti, -weight, weight) * cnt
         return jnp.where(active, signed, 0.0)
 

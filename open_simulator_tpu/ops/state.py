@@ -23,6 +23,16 @@ def node_static_from_table(enc: Encoder, table: NodeTable) -> NodeStatic:
     domain_key = np.full(D, -1, np.int32)
     for did_minus1, k_idx in enumerate(enc.domain_topo):
         domain_key[did_minus1 + 1] = k_idx
+    # one-hot domain membership per topology key: [K,D,N]; segment sums become
+    # matvecs on device (TPU scatters serialize — see kernels._domain_counts).
+    # Key 0 (hostname) is handled natively by the kernels and stays zero here.
+    K = table.topo.shape[1]
+    N = table.n
+    topo_onehot = np.zeros((K, D, N), np.float32)
+    for k in range(1, K):
+        d = table.topo[:, k]
+        rows = np.nonzero((d >= 0) & table.valid)[0]
+        topo_onehot[k, d[rows], rows] = 1.0
     return NodeStatic(
         alloc=jnp.asarray(table.alloc),
         label_pair=jnp.asarray(table.label_pair),
@@ -37,6 +47,7 @@ def node_static_from_table(enc: Encoder, table: NodeTable) -> NodeStatic:
         topo=jnp.asarray(table.topo),
         valid=jnp.asarray(table.valid),
         domain_key=jnp.asarray(domain_key),
+        topo_onehot=jnp.asarray(topo_onehot),
         unsched_key_id=jnp.int32(enc.unsched_key_id),
         empty_val_id=jnp.int32(enc.empty_val_id),
     )

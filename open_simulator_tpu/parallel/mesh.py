@@ -48,6 +48,7 @@ def node_sharding(mesh: Mesh) -> NodeStatic:
         topo=s(NODE_AXIS, None),
         valid=s(NODE_AXIS),
         domain_key=s(None),      # small, replicated
+        topo_onehot=s(None, None, NODE_AXIS),
         unsched_key_id=s(),
         empty_val_id=s(),
     )

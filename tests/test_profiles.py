@@ -1,0 +1,112 @@
+import pytest
+
+from open_simulator_tpu.models.profiles import (
+    default_profile,
+    load_scheduler_config,
+)
+
+
+def test_default_profile_weights():
+    p = default_profile()
+    assert p.weights["topology_spread"] == 2.0
+    assert p.weights["prefer_avoid_pods"] == 10000.0
+    assert p.weights["simon"] == 1.0
+    assert p.percentage_of_nodes_to_score == 100
+
+
+def test_load_scheduler_config(tmp_path):
+    cfg = tmp_path / "sched.yaml"
+    cfg.write_text(
+        """
+apiVersion: kubescheduler.config.k8s.io/v1beta1
+kind: KubeSchedulerConfiguration
+percentageOfNodesToScore: 50
+profiles:
+  - schedulerName: my-scheduler
+    plugins:
+      score:
+        disabled:
+          - name: NodeResourcesLeastAllocated
+        enabled:
+          - name: NodeResourcesBalancedAllocation
+            weight: 5
+          - name: ImageLocality
+            weight: 3
+"""
+    )
+    p = load_scheduler_config(str(cfg))
+    assert p.scheduler_name == "my-scheduler"
+    assert p.weights["least_allocated"] == 0.0
+    assert p.weights["balanced_allocation"] == 5.0
+    assert p.percentage_of_nodes_to_score == 50
+
+
+def test_disable_all_keeps_simon(tmp_path):
+    cfg = tmp_path / "sched.yaml"
+    cfg.write_text(
+        """
+kind: KubeSchedulerConfiguration
+profiles:
+  - plugins:
+      score:
+        disabled: [{name: "*"}]
+"""
+    )
+    p = load_scheduler_config(str(cfg))
+    assert p.weights["simon"] == 1.0
+    assert p.weights["least_allocated"] == 0.0
+
+
+def test_wrong_kind_rejected(tmp_path):
+    cfg = tmp_path / "x.yaml"
+    cfg.write_text("kind: Deployment\n")
+    with pytest.raises(ValueError):
+        load_scheduler_config(str(cfg))
+
+
+def test_weights_affect_placement():
+    """A config downweighting spreading and upweighting simon's worst-fit
+    packs pods instead of spreading them."""
+    from open_simulator_tpu.core.objects import Node, Pod
+    from open_simulator_tpu.engine.simulator import ClusterResource, simulate
+    from open_simulator_tpu.engine.simulator import AppResource
+
+    nodes = [
+        Node.from_dict(
+            {
+                "metadata": {"name": f"n{i}", "labels": {"kubernetes.io/hostname": f"n{i}"}},
+                "status": {"allocatable": {"cpu": "16", "memory": "32Gi", "pods": "110"}},
+            }
+        )
+        for i in range(4)
+    ]
+    deploy = {
+        "kind": "Deployment",
+        "metadata": {"name": "d", "namespace": "x"},
+        "spec": {
+            "replicas": 8,
+            "template": {
+                "metadata": {"labels": {"app": "d"}},
+                "spec": {
+                    "containers": [
+                        {"name": "c", "resources": {"requests": {"cpu": "1", "memory": "1Gi"}}}
+                    ]
+                },
+            },
+        },
+    }
+    cluster = ClusterResource(nodes=nodes)
+    apps = [AppResource(name="a", objects=[deploy])]
+
+    spread_result = simulate(cluster, apps)
+    spread_nodes = {st.node.name for st in spread_result.node_status if st.pods}
+    assert len(spread_nodes) == 4  # default weights spread
+
+    pack_weights = {
+        "simon": 100.0,
+        "least_allocated": 0.0,
+        "balanced_allocation": 0.0,
+    }
+    pack_result = simulate(cluster, apps, weights=pack_weights)
+    pack_nodes = {st.node.name for st in pack_result.node_status if st.pods}
+    assert len(pack_nodes) == 1  # worst-fit-only packs one node
