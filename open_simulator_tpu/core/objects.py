@@ -388,24 +388,42 @@ class Pod:
         return f"{self.meta.namespace}/{self.meta.name}"
 
     def gpu_mem_request(self) -> int:
-        """Per-GPU memory request in GiB units (open-gpu-share annotation)."""
+        """Per-GPU memory request in bytes. The annotation is a resource
+        quantity like `1024Mi` (parity: GetGpuMemoryFromPodAnnotation,
+        pkg/type/open-gpu-share/utils/pod.go:57-67)."""
         v = self.meta.annotations.get(ANNO_GPU_MEM_POD)
+        if v is None:
+            return 0
         try:
-            return int(v) if v is not None else 0
+            return int(parse_quantity(str(v)))
         except ValueError:
             return 0
 
     def gpu_count_request(self) -> int:
-        """GPU count from the open-gpu-share annotation (reference reads
-        alibabacloud.com/gpu-count from pod annotations, utils/pod.go:69-79);
-        defaults to 1 when only gpu-mem is set."""
+        """GPU count from the open-gpu-share annotation (parity:
+        GetGpuCountFromPodAnnotation, utils/pod.go:69-79 — defaults to 0, so a
+        gpu-mem-only pod is unschedulable everywhere, exactly like the
+        reference's AllocateGpuId bailing on reqGpuNum <= 0)."""
         v = self.meta.annotations.get(ANNO_GPU_COUNT_POD)
         try:
             if v is not None and int(v) >= 0:  # reference rejects negatives
                 return int(v)
         except ValueError:
             pass
-        return 1 if self.gpu_mem_request() > 0 else 0
+        return 0
+
+    def gpu_index_ids(self) -> List[int]:
+        """Allocated device ids from the gpu-index annotation, e.g. "2-3-4" ->
+        [2,3,4] (parity: GpuIdStrToIntList, utils/pod.go:102-116). Duplicated
+        ids are legal: the multi-GPU allocator may pack several shares onto one
+        device (gpunodeinfo.go:271-283)."""
+        v = self.meta.annotations.get(ANNO_GPU_INDEX)
+        if not v:
+            return []
+        try:
+            return [int(x) for x in str(v).split("-")]
+        except ValueError:
+            return []
 
 
 @dataclass
@@ -437,3 +455,19 @@ class Node:
     @property
     def name(self) -> str:
         return self.meta.name
+
+    def gpu_total_mem(self) -> int:
+        """Total GPU memory in bytes from status.capacity (parity:
+        GetTotalGpuMemory, pkg/type/open-gpu-share/utils/node.go:11-17)."""
+        return self.capacity.get(ANNO_GPU_MEM_POD, 0)
+
+    def gpu_count(self) -> int:
+        """Number of physical GPUs from status.capacity (parity:
+        GetGpuCountInNode, utils/node.go:20-26)."""
+        return self.capacity.get(RESOURCE_GPU_COUNT, 0)
+
+    def gpu_mem_per_device(self) -> int:
+        """Per-device memory in bytes (parity: DeviceInfo totalGpuMem =
+        nodeGpuMem / gpuCount, pkg/type/open-gpu-share/cache/deviceinfo.go)."""
+        c = self.gpu_count()
+        return self.gpu_total_mem() // c if c > 0 else 0

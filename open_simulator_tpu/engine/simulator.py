@@ -25,6 +25,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..core.objects import (
+    ANNO_GPU_INDEX,
     ANNO_NODE_LOCAL_STORAGE,
     DEFAULT_SCHEDULER,
     Node,
@@ -33,6 +34,7 @@ from ..core.objects import (
 from ..core.workloads import WORKLOAD_KINDS, pods_from_workload
 from ..ops.encode import (
     Encoder,
+    aggregate_gpu_usage,
     aggregate_usage,
     encode_nodes,
     encode_pods,
@@ -172,7 +174,10 @@ class Simulator:
         for pod, _ in self._bound:
             self.enc.register_pods([pod])
         self._table = encode_nodes(
-            self.enc, self.cluster.nodes, existing_usage=aggregate_usage(self._bound)
+            self.enc,
+            self.cluster.nodes,
+            existing_usage=aggregate_usage(self._bound),
+            existing_gpu=aggregate_gpu_usage(self.cluster.nodes, self._bound),
         )
         self._ns = node_static_from_table(self.enc, self._table)
         sel = initial_selector_counts(self.enc, self._table, self._bound)
@@ -186,7 +191,7 @@ class Simulator:
         self._carry = align_sel_counts(self._carry, len(self.enc.selectors))
         # Grouped path: identical results to the naive scan, but static
         # filter/score work is hoisted per run of identical pods.
-        self._carry, placed_np, reasons_np = schedule_batch_grouped(
+        self._carry, placed_np, reasons_np, take_np = schedule_batch_grouped(
             self._ns, self._carry, batch, self.weights
         )
         failed: List[UnscheduledPod] = []
@@ -196,6 +201,17 @@ class Simulator:
             if ni >= 0:
                 pod.node_name = self._table.names[ni]
                 pod.phase = "Running"
+                if pod.gpu_mem_request() > 0:
+                    # Device ids in allocation order, duplicates = multiple
+                    # shares packed onto one device (parity: the gpu-index
+                    # annotation codec, utils/pod.go:102-116).
+                    ids = [
+                        str(d)
+                        for d in range(take_np.shape[1])
+                        for _ in range(int(take_np[i, d]))
+                    ]
+                    if ids:
+                        pod.meta.annotations[ANNO_GPU_INDEX] = "-".join(ids)
                 self._bound.append((pod, pod.node_name))
             else:
                 failed.append(

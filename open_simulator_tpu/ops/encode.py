@@ -26,6 +26,8 @@ import numpy as np
 
 from ..core.matcher import match_label_selector
 from ..core.objects import (
+    ANNO_GPU_MEM_POD,
+    RESOURCE_GPU_COUNT,
     LabelSelector,
     Node,
     Pod,
@@ -34,7 +36,18 @@ from ..core.objects import (
 # Resource scaling: canonical int units -> f32-safe units.
 # cpu is already milli; byte-like resources go to MiB so f32's 24-bit mantissa
 # stays exact up to 16 TiB per node.
-_BYTE_LIKE = ("memory", "ephemeral-storage", "storage", "hugepages-")
+_BYTE_LIKE = (
+    "memory", "ephemeral-storage", "storage", "hugepages-",
+    "alibabacloud.com/gpu-mem",
+)
+
+# Fixed resource-axis index of the whole-GPU count extended resource
+# (alibabacloud.com/gpu-count). Its node allocatable is DYNAMIC in the
+# reference — the gpu-share plugin's Reserve rewrites it to the number of
+# fully-idle devices (open-gpu-share.go:183-190) — so the kernels recompute
+# effective availability from the per-device state instead of trusting the
+# static row (kernels.run_filters).
+GPU_COUNT_IDX = 3
 _EFFECTS = {"NoSchedule": 1, "PreferNoSchedule": 2, "NoExecute": 3}
 
 OP_PAD, OP_IN, OP_NOT_IN, OP_EXISTS, OP_NOT_EXISTS, OP_GT, OP_LT = range(7)
@@ -112,7 +125,8 @@ class Encoder:
         self.empty_val_id = self.vals.id("")
         self.pairs = Vocab()       # "key=value"
         self.names = Vocab()       # node names
-        self.resources: List[str] = ["cpu", "memory", "pods"]
+        self.resources: List[str] = ["cpu", "memory", "pods", RESOURCE_GPU_COUNT]
+        assert self.resources[GPU_COUNT_IDX] == RESOURCE_GPU_COUNT
         # kubernetes.io/hostname is pinned at index 0: its domains are the
         # nodes themselves, handled natively by the kernels (a dense one-hot
         # for it would be O(N^2) memory — kernels.HOSTNAME_KEY_IDX).
@@ -200,6 +214,8 @@ class NodeTable:
     avoid_pods: np.ndarray  # bool[N] NodePreferAvoidPods annotation present
     topo: np.ndarray        # i32[N,K] domain id or -1
     valid: np.ndarray       # bool[N]
+    gpu_total: np.ndarray   # f32[N,G] per-device total GPU mem, MiB (0 = none)
+    gpu_free: np.ndarray    # f32[N,G] per-device free after existing pods
     names: List[str] = field(default_factory=list)
 
     @property
@@ -213,6 +229,8 @@ class PodBatch:
     req: np.ndarray            # f32[P,R]
     has_req: np.ndarray        # bool[P] (simon score: empty requests => max)
     node_name_id: np.ndarray   # i32[P] 0 = unpinned
+    gpu_mem: np.ndarray        # f32[P] per-GPU shared-memory request, MiB
+    gpu_num: np.ndarray        # f32[P] number of GPU shares requested
     # required node affinity: OR over TERM terms, AND over EXPR exprs each
     sel_op: np.ndarray         # i32[P,TERM,EXPR]
     sel_key: np.ndarray        # i32[P,TERM,EXPR]
@@ -266,16 +284,19 @@ def encode_nodes(
     enc: Encoder,
     nodes: Sequence[Node],
     existing_usage: Optional[Dict[str, Dict[str, int]]] = None,
+    existing_gpu: Optional[Dict[str, np.ndarray]] = None,
     n_pad: Optional[int] = None,
 ) -> NodeTable:
     """Build the node table. existing_usage maps node name -> canonical request
-    totals of already-bound pods (subtracted into `free`)."""
+    totals of already-bound pods (subtracted into `free`); existing_gpu maps
+    node name -> used MiB per device (from aggregate_gpu_usage)."""
     n = len(nodes)
     N = n_pad if n_pad is not None else round_up(n)
     R = len(enc.resources)
     L = round_up(max((len(nd.meta.labels) for nd in nodes), default=1), 4)
     T = round_up(max((len(nd.taints) for nd in nodes), default=1), 2)
     K = max(len(enc.topology_keys), 1)
+    G = round_up(max((nd.gpu_count() for nd in nodes), default=1), 2)
 
     alloc = np.zeros((N, R), np.float32)
     free = np.zeros((N, R), np.float32)
@@ -290,8 +311,11 @@ def encode_nodes(
     avoid = np.zeros(N, bool)
     topo = np.full((N, K), -1, np.int32)
     valid = np.zeros(N, bool)
+    gpu_total = np.zeros((N, G), np.float32)
+    gpu_free = np.zeros((N, G), np.float32)
 
     usage = existing_usage or {}
+    gpu_usage = existing_gpu or {}
     for i, nd in enumerate(nodes):
         valid[i] = True
         name_id[i] = enc.names.id(nd.name)
@@ -319,12 +343,21 @@ def encode_nodes(
             v = nd.meta.labels.get(key)
             if v is not None:
                 topo[i, k_idx] = enc.domain_id(k_idx, key, v)
+        g_cnt = nd.gpu_count()
+        if g_cnt > 0:
+            per_dev = np.float32(nd.gpu_mem_per_device() / float(1 << 20))
+            gpu_total[i, :g_cnt] = per_dev
+            gpu_free[i, :g_cnt] = per_dev
+            used = gpu_usage.get(nd.name)
+            if used is not None:
+                gpu_free[i, : len(used)] -= used.astype(np.float32)
 
     return NodeTable(
         alloc=alloc, free=free, label_pair=label_pair, label_key=label_key,
         label_num=label_num, taint_key=taint_key, taint_val=taint_val,
         taint_effect=taint_effect, name_id=name_id, unsched=unsched,
         avoid_pods=avoid, topo=topo, valid=valid,
+        gpu_total=gpu_total, gpu_free=gpu_free,
         names=[nd.name for nd in nodes],
     )
 
@@ -412,6 +445,8 @@ def encode_pods(
         req=np.zeros((P, R), np.float32),
         has_req=np.zeros(P, bool),
         node_name_id=np.zeros(P, np.int32),
+        gpu_mem=np.zeros(P, np.float32),
+        gpu_num=np.zeros(P, np.float32),
         sel_op=np.zeros((P, TERM, EXPR), np.int32),
         sel_key=np.zeros((P, TERM, EXPR), np.int32),
         sel_val=np.zeros((P, TERM, EXPR, VAL), np.int32),
@@ -450,6 +485,8 @@ def encode_pods(
         for res, q in pod.requests.items():
             b.req[i, enc.resource_index(res)] = q / resource_scale(res)
         b.req[i, enc.resources.index("pods")] += 1.0  # each pod occupies a slot
+        b.gpu_mem[i] = np.float32(pod.gpu_mem_request() / float(1 << 20))
+        b.gpu_num[i] = float(pod.gpu_count_request())
         if pod.node_name:
             b.node_name_id[i] = enc.names.id(pod.node_name)
         for j, t in enumerate(pod.affinity.node_required[:TERM]):
@@ -493,6 +530,66 @@ def encode_pods(
             b.match_sel[i, s] = entry.matches(pod)
 
     return b
+
+
+def host_allocate_gpu(free: np.ndarray, mem: float, num: int) -> Optional[List[int]]:
+    """Host mirror of GpuNodeInfo.AllocateGpuId (gpunodeinfo.go:232-290):
+    single-GPU pods take the tightest-fitting device (min free >= mem, ties to
+    the lowest id); multi-GPU pods run the two-pointer greedy that may pack
+    several shares onto one device. Returns the device-id list or None.
+    `free` is mutated on success (used MiB subtracted)."""
+    if mem <= 0 or num <= 0:
+        return None
+    if num == 1:
+        best = -1
+        best_free = np.float32(0)
+        for d in range(len(free)):
+            if free[d] >= mem and (best < 0 or free[d] < best_free):
+                best, best_free = d, free[d]
+        if best < 0:
+            return None
+        free[best] -= np.float32(mem)
+        return [best]
+    ids: List[int] = []
+    d = 0
+    while d < len(free) and len(ids) < num:
+        if free[d] >= mem:
+            ids.append(d)
+            free[d] -= np.float32(mem)
+        else:
+            d += 1
+    if len(ids) < num:
+        return None
+    return ids
+
+
+def aggregate_gpu_usage(
+    nodes: Sequence[Node], placed: Sequence[Tuple[Pod, str]]
+) -> Dict[str, np.ndarray]:
+    """Per-node used-MiB-per-device arrays for already-bound GPU pods.
+
+    Only pods carrying a gpu-index annotation contribute, and only to devices
+    that exist (parity: addOrUpdatePod skips pods whose annotation is missing
+    or unparseable, gpunodeinfo.go:122-140). The scheduler cache skips
+    Succeeded/Failed pods (deviceinfo.go:45-67)."""
+    by_name = {nd.name: nd for nd in nodes}
+    used: Dict[str, np.ndarray] = {}
+    for pod, node_name in placed:
+        mem_bytes = pod.gpu_mem_request()
+        if mem_bytes <= 0 or pod.phase in ("Succeeded", "Failed"):
+            continue
+        nd = by_name.get(node_name)
+        if nd is None or nd.gpu_count() <= 0:
+            continue
+        ids = pod.gpu_index_ids()
+        if not ids:
+            continue
+        mem = np.float32(mem_bytes / float(1 << 20))
+        arr = used.setdefault(node_name, np.zeros(nd.gpu_count(), np.float32))
+        for d in ids:
+            if 0 <= d < len(arr):
+                arr[d] += mem
+    return used
 
 
 def aggregate_usage(placed: Sequence[Tuple[Pod, str]]) -> Dict[str, Dict[str, int]]:

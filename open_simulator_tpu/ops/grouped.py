@@ -31,6 +31,7 @@ import numpy as np
 from .encode import PodBatch
 from .kernels import (
     Carry,
+    F_GPU,
     F_NODE_AFFINITY,
     F_NODE_NAME,
     F_POD_AFFINITY,
@@ -43,9 +44,13 @@ from .kernels import (
     PodRow,
     WEIGHT_ORDER,
     _EPS,
+    gpu_allocate,
+    gpu_mask,
     node_affinity_mask,
     pod_affinity_mask,
+    resource_fail,
     score_balanced,
+    score_gpu_share,
     score_inter_pod_affinity,
     score_least_allocated,
     score_node_affinity,
@@ -106,10 +111,11 @@ def schedule_group(
 
     def step(c: Carry, i):
         active = i < valid_count
-        res_fail = jnp.any(pod.req[None, :] > c.free + _EPS, axis=1)
+        res_fail = resource_fail(ns, c, pod)
         spread_ok = spread_mask(ns, c, pod)
         aff_ok = pod_affinity_mask(ns, c, pod)
-        mask = static_ok & ~res_fail & spread_ok & aff_ok & ns.valid
+        gpu_ok = gpu_mask(ns, c, pod)
+        mask = static_ok & ~res_fail & spread_ok & aff_ok & gpu_ok & ns.valid
 
         # Stack in WEIGHT_ORDER exactly like run_scores so the f32 summation
         # order (and therefore every tie-break) matches the naive kernel.
@@ -118,6 +124,7 @@ def schedule_group(
             "least_allocated": score_least_allocated(ns, c, pod),
             "topology_spread": score_topology_spread(ns, c, pod),
             "inter_pod_affinity": score_inter_pod_affinity(ns, c, pod),
+            "gpu_share": score_gpu_share(ns, c, pod),
             **static_scores,
         }
         stacked = jnp.stack([by_name[k] for k in WEIGHT_ORDER], axis=0)
@@ -133,6 +140,7 @@ def schedule_group(
             pod.match_sel.astype(jnp.float32)[:, None]
             * onehot.astype(jnp.float32)[None, :]
         )
+        gpu_take, gpu_free = gpu_allocate(ns, c, pod, onehot)
 
         first_fail = jnp.where(
             static_ff < NUM_FILTERS,
@@ -143,7 +151,11 @@ def schedule_group(
                 jnp.where(
                     ~spread_ok,
                     F_SPREAD,
-                    jnp.where(~aff_ok, F_POD_AFFINITY, NUM_FILTERS),
+                    jnp.where(
+                        ~aff_ok,
+                        F_POD_AFFINITY,
+                        jnp.where(~gpu_ok, F_GPU, NUM_FILTERS),
+                    ),
                 ),
             ),
         )
@@ -152,9 +164,10 @@ def schedule_group(
         ].add(jnp.where((first_fail < NUM_FILTERS) & ns.valid, 1, 0))
         reason_counts = jnp.where(ok, jnp.zeros_like(reason_counts), reason_counts)
 
-        return Carry(free=free, sel_counts=sel_counts), (
+        return Carry(free=free, sel_counts=sel_counts, gpu_free=gpu_free), (
             node_out.astype(jnp.int32),
             reason_counts,
+            gpu_take.astype(jnp.int32),
         )
 
     return jax.lax.scan(step, carry, jnp.arange(group_size))
@@ -207,15 +220,18 @@ def schedule_batch_grouped(
     batch: PodBatch,
     weights,
     max_group_chunk: int = 16384,
-) -> Tuple[Carry, np.ndarray, np.ndarray]:
+) -> Tuple[Carry, np.ndarray, np.ndarray, np.ndarray]:
     """schedule_batch semantics via per-group inner scans.
 
-    Returns (carry, nodes i32[batch.p], reasons i32[batch.p, F]) — identical
-    to the naive kernel's output for the same batch.
+    Returns (carry, nodes i32[batch.p], reasons i32[batch.p, F],
+    gpu_take i32[batch.p, G]) — identical to the naive kernel's output for the
+    same batch.
     """
     P = batch.p
+    G = ns.gpu_total.shape[1]
     nodes_out = np.full(P, -1, np.int32)
     reasons_out = np.zeros((P, NUM_FILTERS), np.int32)
+    take_out = np.zeros((P, G), np.int32)
     rows_all = pod_rows_from_batch(batch)
 
     for start, length in group_runs(batch):
@@ -224,10 +240,11 @@ def schedule_batch_grouped(
         while done < length:
             n = min(length - done, max_group_chunk)
             g = _bucket(n)
-            carry, (nodes, reasons) = _group_jit(
+            carry, (nodes, reasons, take) = _group_jit(
                 ns, carry, row, g, jnp.int32(n), weights
             )
             nodes_out[start + done : start + done + n] = np.asarray(nodes)[:n]
             reasons_out[start + done : start + done + n] = np.asarray(reasons)[:n]
+            take_out[start + done : start + done + n] = np.asarray(take)[:n]
             done += n
-    return carry, nodes_out, reasons_out
+    return carry, nodes_out, reasons_out, take_out

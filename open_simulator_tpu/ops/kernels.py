@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from .encode import (
+    GPU_COUNT_IDX,
     OP_GT,
     OP_IN,
     OP_LT,
@@ -46,7 +47,8 @@ F_NODE_AFFINITY = 3
 F_RESOURCES = 4
 F_SPREAD = 5
 F_POD_AFFINITY = 6
-NUM_FILTERS = 7
+F_GPU = 7
+NUM_FILTERS = 8
 
 FILTER_MESSAGES = (
     "node(s) were unschedulable",
@@ -56,6 +58,7 @@ FILTER_MESSAGES = (
     "Insufficient resources",
     "node(s) didn't match pod topology spread constraints",
     "node(s) didn't match pod affinity/anti-affinity rules",
+    "node(s) didn't have enough free GPU memory",
 )
 
 # Score weights, matching the default v1beta1 provider weights
@@ -69,6 +72,7 @@ DEFAULT_WEIGHTS = {
     "inter_pod_affinity": 1.0,
     "prefer_avoid_pods": 10000.0,
     "simon": 1.0,
+    "gpu_share": 1.0,
 }
 WEIGHT_ORDER = tuple(sorted(DEFAULT_WEIGHTS))
 
@@ -91,6 +95,7 @@ class NodeStatic(NamedTuple):
     avoid_pods: jnp.ndarray   # bool[N]
     topo: jnp.ndarray         # i32[N,K] domain id or -1
     valid: jnp.ndarray        # bool[N]
+    gpu_total: jnp.ndarray    # f32[N,G] per-device total GPU mem MiB (0=none)
     domain_key: jnp.ndarray   # i32[D] topo-key index per domain id (-1 pad)
     topo_onehot: jnp.ndarray  # f32[K,D,N] domain membership (0 for missing key)
     unsched_key_id: jnp.ndarray  # i32 scalar: key id of node.kubernetes.io/unschedulable
@@ -101,6 +106,9 @@ class Carry(NamedTuple):
     """Mutable cluster state threaded through the scan."""
     free: jnp.ndarray        # f32[N,R]
     sel_counts: jnp.ndarray  # f32[S,N]
+    gpu_free: jnp.ndarray    # f32[N,G] per-device free GPU mem MiB
+                             # (tracks annotation pods only, like the
+                             # reference's SchedulerCache)
 
 
 class PodRow(NamedTuple):
@@ -108,6 +116,8 @@ class PodRow(NamedTuple):
     req: jnp.ndarray
     has_req: jnp.ndarray
     node_name_id: jnp.ndarray
+    gpu_mem: jnp.ndarray
+    gpu_num: jnp.ndarray
     sel_op: jnp.ndarray
     sel_key: jnp.ndarray
     sel_val: jnp.ndarray
@@ -301,6 +311,90 @@ def pod_affinity_mask(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
     return jnp.all(per_a, axis=1)
 
 
+# ---------------------------------------------------------------------------
+# Open-Gpu-Share: per-device GPU memory packing
+# (parity: pkg/simulator/plugin/open-gpu-share.go + AllocateGpuId,
+#  pkg/type/open-gpu-share/cache/gpunodeinfo.go:232-290)
+# ---------------------------------------------------------------------------
+
+def _gpu_device_caps(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
+    """floor(free_d / mem) shares each device can still hold -> f32[N,G]."""
+    mem = jnp.maximum(pod.gpu_mem, 1e-9)
+    caps = jnp.floor((carry.gpu_free + _EPS) / mem)
+    return jnp.where(ns.gpu_total > 0, caps, 0.0)
+
+
+def allocatable_gpus(ns: NodeStatic, carry: Carry) -> jnp.ndarray:
+    """Number of not-fully-used devices per node -> f32[N]. This is the
+    DYNAMIC value the reference writes back into node allocatable
+    `alibabacloud.com/gpu-count` on every Reserve (open-gpu-share.go:183-190):
+    GpuAllocatable = gpuCount - #(used >= total), so a partially-used device
+    still counts (gpunodeinfo.go:355-362)."""
+    usable = (carry.gpu_free > _EPS) & (ns.gpu_total > 0)
+    return jnp.sum(usable.astype(jnp.float32), axis=1)
+
+
+def gpu_mask(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
+    """Open-Gpu-Share Filter: non-GPU pods pass everywhere; GPU pods need a
+    feasible device packing. The two-pointer greedy of AllocateGpuId succeeds
+    iff sum_d floor(free_d/mem) >= num (it never strands capacity: a device is
+    only abandoned when it can't hold another share)."""
+    is_gpu = pod.gpu_mem > 0
+    caps = _gpu_device_caps(ns, carry, pod)
+    feasible = (pod.gpu_num >= 1) & (jnp.sum(caps, axis=1) >= pod.gpu_num)
+    return jnp.where(is_gpu, feasible, jnp.ones_like(feasible))
+
+
+def gpu_allocate(
+    ns: NodeStatic, carry: Carry, pod: PodRow, node_onehot: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Allocate devices on the selected node -> (take f32[G] shares per device,
+    new gpu_free f32[N,G]).
+
+    num == 1: tightest fit — the device with the least free memory that still
+    fits, ties to the lowest id (gpunodeinfo.go:256-270, strict `<` keeps the
+    earlier candidate).
+    num > 1: the two-pointer greedy packs shares onto the lowest-id devices
+    first, reusing a device while it fits (gpunodeinfo.go:271-286); that is
+    exactly take_d = clip(num - prefix_d, 0, cap_d) with prefix the exclusive
+    cumsum of caps."""
+    sel = node_onehot.astype(jnp.float32)
+    free_d = jnp.einsum("n,ng->g", sel, carry.gpu_free)
+    total_d = jnp.einsum("n,ng->g", sel, ns.gpu_total)
+    mem = pod.gpu_mem
+
+    elig = (total_d > 0) & (free_d >= mem - _EPS)
+    tight = jnp.argmin(jnp.where(elig, free_d, jnp.inf))
+    take_single = (
+        (jnp.arange(free_d.shape[0]) == tight) & jnp.any(elig)
+    ).astype(jnp.float32)
+
+    caps = jnp.where(
+        total_d > 0, jnp.floor((free_d + _EPS) / jnp.maximum(mem, 1e-9)), 0.0
+    )
+    prefix = jnp.cumsum(caps) - caps
+    take_multi = jnp.clip(pod.gpu_num - prefix, 0.0, caps)
+    take_multi = jnp.where(jnp.sum(caps) >= pod.gpu_num, take_multi, 0.0)
+
+    take = jnp.where(pod.gpu_num == 1, take_single, take_multi)
+    take = jnp.where((mem > 0) & (pod.gpu_num >= 1), take, 0.0)
+    gpu_free = carry.gpu_free - sel[:, None] * take[None, :] * mem
+    return take, gpu_free
+
+
+def resource_fail(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
+    """NodeResourcesFit failure -> bool[N]. The whole-GPU extended resource
+    (alibabacloud.com/gpu-count) is checked against its DYNAMIC allocatable —
+    the number of not-fully-used devices minus already-committed whole-GPU
+    requests — because the reference rewrites that allocatable on every
+    Reserve (open-gpu-share.go:183-190)."""
+    static_fail = jnp.any(pod.req[None, :] > carry.free + _EPS, axis=1)
+    whole_req = pod.req[GPU_COUNT_IDX]
+    whole_used = ns.alloc[:, GPU_COUNT_IDX] - carry.free[:, GPU_COUNT_IDX]
+    whole_fail = whole_req > allocatable_gpus(ns, carry) - whole_used + _EPS
+    return static_fail | whole_fail
+
+
 def run_filters(ns: NodeStatic, carry: Carry, pod: PodRow):
     """All filter plugins -> (mask bool[N], first_fail i32[N]).
 
@@ -322,9 +416,10 @@ def run_filters(ns: NodeStatic, carry: Carry, pod: PodRow):
             (pod.node_name_id != 0) & (ns.name_id != pod.node_name_id),
             ~taint_mask(ns, pod),
             ~node_affinity_mask(ns, pod),
-            jnp.any(pod.req[None, :] > carry.free + _EPS, axis=1),
+            resource_fail(ns, carry, pod),
             ~spread_mask(ns, carry, pod),
             ~pod_affinity_mask(ns, carry, pod),
+            ~gpu_mask(ns, carry, pod),
         ],
         axis=1,
     )                                                           # [N,F]
@@ -457,6 +552,30 @@ def score_inter_pod_affinity(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.n
     return jnp.where(any_active, normalized, 0.0)
 
 
+def score_gpu_share(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
+    """Open-Gpu-Share Score (open-gpu-share.go:85-110): the same worst-fit
+    share as Simon but over the node's CURRENT allocatable — where the
+    whole-GPU count dimension is the dynamic allocatable-device count — then
+    min-max normalized by the plugin's own NormalizeScore."""
+    req = pod.req[None, :]                                    # [1,R]
+    alloc = ns.alloc
+    R = alloc.shape[1]
+    dyn = allocatable_gpus(ns, carry)                          # [N]
+    alloc = jnp.where(
+        (jnp.arange(R) == GPU_COUNT_IDX)[None, :], dyn[:, None], alloc
+    )
+    avail = alloc - req
+    share = jnp.where(
+        req == 0,
+        0.0,
+        jnp.where(avail == 0, 1.0, req / jnp.where(avail == 0, 1.0, avail)),
+    )
+    share = jnp.where(avail < 0, 1.0, share)
+    raw = jnp.max(share, axis=1) * 100.0
+    raw = jnp.where(pod.has_req, raw, 100.0)                  # empty req => Max
+    return _minmax_normalize(raw, ns.valid)
+
+
 def run_scores(ns: NodeStatic, carry: Carry, pod: PodRow, weights: jnp.ndarray) -> jnp.ndarray:
     """Weighted sum of all normalized score plugins -> f32[N]."""
     by_name = {
@@ -468,6 +587,7 @@ def run_scores(ns: NodeStatic, carry: Carry, pod: PodRow, weights: jnp.ndarray) 
         "inter_pod_affinity": score_inter_pod_affinity(ns, carry, pod),
         "prefer_avoid_pods": score_prefer_avoid(ns, pod),
         "simon": score_simon(ns, carry, pod),
+        "gpu_share": score_gpu_share(ns, carry, pod),
     }
     stacked = jnp.stack([by_name[k] for k in WEIGHT_ORDER], axis=0)  # [W,N]
     return jnp.sum(stacked * weights[:, None], axis=0)
@@ -490,25 +610,31 @@ def schedule_step(ns: NodeStatic, weights: jnp.ndarray, carry: Carry, pod: PodRo
     sel_counts = carry.sel_counts + (
         pod.match_sel.astype(jnp.float32)[:, None] * onehot.astype(jnp.float32)[None, :]
     )
+    gpu_take, gpu_free = gpu_allocate(ns, carry, pod, onehot)
 
     reason_counts = jnp.zeros(NUM_FILTERS, jnp.int32).at[
         jnp.clip(first_fail, 0, NUM_FILTERS - 1)
     ].add(jnp.where((first_fail < NUM_FILTERS) & ns.valid, 1, 0))
     reason_counts = jnp.where(ok, jnp.zeros_like(reason_counts), reason_counts)
 
-    new_carry = Carry(free=free, sel_counts=sel_counts)
-    return new_carry, (node_out.astype(jnp.int32), reason_counts)
+    new_carry = Carry(free=free, sel_counts=sel_counts, gpu_free=gpu_free)
+    return new_carry, (
+        node_out.astype(jnp.int32),
+        reason_counts,
+        gpu_take.astype(jnp.int32),
+    )
 
 
 @functools.partial(jax.jit, static_argnames=())
 def schedule_batch(ns: NodeStatic, carry: Carry, pods: PodRow, weights: jnp.ndarray):
     """Schedule a whole PodBatch sequentially on device.
 
-    Returns (final_carry, nodes i32[P] (-1 = unschedulable), reasons i32[P,F]).
+    Returns (final_carry, nodes i32[P] (-1 = unschedulable), reasons i32[P,F],
+    gpu_take i32[P,G] — shares allocated per device on the chosen node).
     """
 
     def step(c, pod):
         return schedule_step(ns, weights, c, pod)
 
-    final_carry, (nodes, reasons) = jax.lax.scan(step, carry, pods)
-    return final_carry, nodes, reasons
+    final_carry, (nodes, reasons, gpu_take) = jax.lax.scan(step, carry, pods)
+    return final_carry, nodes, reasons, gpu_take

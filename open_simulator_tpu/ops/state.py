@@ -46,6 +46,7 @@ def node_static_from_table(enc: Encoder, table: NodeTable) -> NodeStatic:
         avoid_pods=jnp.asarray(table.avoid_pods),
         topo=jnp.asarray(table.topo),
         valid=jnp.asarray(table.valid),
+        gpu_total=jnp.asarray(table.gpu_total),
         domain_key=jnp.asarray(domain_key),
         topo_onehot=jnp.asarray(topo_onehot),
         unsched_key_id=jnp.int32(enc.unsched_key_id),
@@ -58,7 +59,11 @@ def carry_from_table(
 ) -> Carry:
     if sel_counts is None:
         sel_counts = np.zeros((max(num_selectors, 1), table.n), np.float32)
-    return Carry(free=jnp.asarray(table.free), sel_counts=jnp.asarray(sel_counts))
+    return Carry(
+        free=jnp.asarray(table.free),
+        sel_counts=jnp.asarray(sel_counts),
+        gpu_free=jnp.asarray(table.gpu_free),
+    )
 
 
 def pod_rows_from_batch(batch: PodBatch) -> PodRow:
@@ -67,6 +72,8 @@ def pod_rows_from_batch(batch: PodBatch) -> PodRow:
         req=jnp.asarray(batch.req),
         has_req=jnp.asarray(batch.has_req),
         node_name_id=jnp.asarray(batch.node_name_id),
+        gpu_mem=jnp.asarray(batch.gpu_mem),
+        gpu_num=jnp.asarray(batch.gpu_num),
         sel_op=jnp.asarray(batch.sel_op),
         sel_key=jnp.asarray(batch.sel_key),
         sel_val=jnp.asarray(batch.sel_val),
@@ -105,4 +112,4 @@ def align_sel_counts(carry: Carry, num_selectors: int) -> Carry:
     if S <= S_old:
         return carry
     grown = jnp.zeros((S, N), jnp.float32).at[:S_old].set(carry.sel_counts)
-    return Carry(free=carry.free, sel_counts=grown)
+    return Carry(free=carry.free, sel_counts=grown, gpu_free=carry.gpu_free)

@@ -6,7 +6,7 @@ into O(templates)."""
 
 from __future__ import annotations
 
-from dataclasses import replace
+from dataclasses import fields, replace
 from typing import List, Sequence
 
 import numpy as np
@@ -38,38 +38,9 @@ def tile_pod_batch(batch: PodBatch, counts: Sequence[int]) -> PodBatch:
         base = batch.keys[i] if i < len(batch.keys) else f"tpl-{i}"
         keys.extend(f"{base}-{j}" for j in range(c))
 
-    return replace(
-        batch,
-        req=grow(batch.req),
-        has_req=grow(batch.has_req),
-        node_name_id=grow(batch.node_name_id),
-        sel_op=grow(batch.sel_op),
-        sel_key=grow(batch.sel_key),
-        sel_val=grow(batch.sel_val),
-        sel_num=grow(batch.sel_num),
-        has_terms=grow(batch.has_terms),
-        ns_pair=grow(batch.ns_pair),
-        pref_weight=grow(batch.pref_weight),
-        pref_op=grow(batch.pref_op),
-        pref_key=grow(batch.pref_key),
-        pref_val=grow(batch.pref_val),
-        pref_num=grow(batch.pref_num),
-        tol_key=grow(batch.tol_key),
-        tol_val=grow(batch.tol_val),
-        tol_exists=grow(batch.tol_exists),
-        tol_effect=grow(batch.tol_effect),
-        tol_valid=grow(batch.tol_valid),
-        spread_topo=grow(batch.spread_topo),
-        spread_sel=grow(batch.spread_sel),
-        spread_skew=grow(batch.spread_skew),
-        spread_hard=grow(batch.spread_hard),
-        aff_topo=grow(batch.aff_topo),
-        aff_sel=grow(batch.aff_sel),
-        aff_anti=grow(batch.aff_anti),
-        aff_required=grow(batch.aff_required),
-        aff_weight=grow(batch.aff_weight),
-        match_sel=grow(batch.match_sel),
-        owned_by_rs=grow(batch.owned_by_rs),
-        valid=grow(batch.valid),
-        keys=keys,
-    )
+    grown = {
+        f.name: grow(getattr(batch, f.name))
+        for f in fields(batch)
+        if f.name != "keys"
+    }
+    return replace(batch, keys=keys, **grown)

@@ -47,6 +47,7 @@ def node_sharding(mesh: Mesh) -> NodeStatic:
         avoid_pods=s(NODE_AXIS),
         topo=s(NODE_AXIS, None),
         valid=s(NODE_AXIS),
+        gpu_total=s(NODE_AXIS, None),
         domain_key=s(None),      # small, replicated
         topo_onehot=s(None, None, NODE_AXIS),
         unsched_key_id=s(),
@@ -56,7 +57,11 @@ def node_sharding(mesh: Mesh) -> NodeStatic:
 
 def carry_sharding(mesh: Mesh) -> Carry:
     s = lambda *spec: NamedSharding(mesh, P(*spec))
-    return Carry(free=s(NODE_AXIS, None), sel_counts=s(None, NODE_AXIS))
+    return Carry(
+        free=s(NODE_AXIS, None),
+        sel_counts=s(None, NODE_AXIS),
+        gpu_free=s(NODE_AXIS, None),
+    )
 
 
 def replicated(mesh: Mesh, tree):
@@ -83,8 +88,8 @@ def sharded_schedule_batch(mesh: Mesh):
         def step(c, pod):
             return schedule_step(ns, weights, c, pod)
 
-        final_carry, (nodes, reasons) = jax.lax.scan(step, carry, pods)
-        return final_carry, nodes, reasons
+        final_carry, (nodes, reasons, gpu_take) = jax.lax.scan(step, carry, pods)
+        return final_carry, nodes, reasons, gpu_take
 
     rep = NamedSharding(mesh, P())
     return jax.jit(
@@ -95,5 +100,5 @@ def sharded_schedule_batch(mesh: Mesh):
             None,     # pods: let XLA replicate
             rep,      # weights
         ),
-        out_shardings=(carry_sharding(mesh), rep, rep),
+        out_shardings=(carry_sharding(mesh), rep, rep, rep),
     )

@@ -61,4 +61,7 @@ def test_negative_gpu_count_annotation_rejected():
             "spec": {"containers": []},
         }
     )
-    assert pod.gpu_count_request() == 1  # falls back to gpu-mem>0 => 1
+    # negative counts are rejected and the default is 0 (parity:
+    # GetGpuCountFromPodAnnotation, utils/pod.go:71-79) — the pod is then
+    # unschedulable everywhere, like the reference's AllocateGpuId bail-out
+    assert pod.gpu_count_request() == 0

@@ -1,0 +1,248 @@
+"""Open-Gpu-Share parity tests.
+
+Allocation semantics under test mirror GpuNodeInfo.AllocateGpuId
+(`/root/reference/pkg/type/open-gpu-share/cache/gpunodeinfo.go:232-290`):
+single-GPU pods take the tightest-fitting device; multi-GPU pods run a
+two-pointer greedy that may pack several shares onto one device. The e2e test
+feeds the reference's own gpushare example manifests through the engine.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from open_simulator_tpu.core.objects import (
+    ANNO_GPU_INDEX,
+    Node,
+    Pod,
+)
+from open_simulator_tpu.engine.simulator import (
+    AppResource,
+    ClusterResource,
+    simulate,
+)
+from open_simulator_tpu.ops.encode import (
+    Encoder,
+    aggregate_gpu_usage,
+    encode_nodes,
+    encode_pods,
+    host_allocate_gpu,
+    initial_selector_counts,
+)
+from open_simulator_tpu.ops.kernels import F_GPU, schedule_batch, weights_array
+from open_simulator_tpu.ops.state import (
+    carry_from_table,
+    node_static_from_table,
+    pod_rows_from_batch,
+)
+
+REF_EXAMPLE = "/root/reference/example"
+
+
+def gpu_node(name, count, per_dev_mib, cpu="32", mem="128Gi"):
+    total = count * per_dev_mib
+    return Node.from_dict(
+        {
+            "metadata": {"name": name},
+            "status": {
+                "allocatable": {
+                    "cpu": cpu,
+                    "memory": mem,
+                    "pods": "110",
+                    "alibabacloud.com/gpu-count": str(count),
+                    "alibabacloud.com/gpu-mem": f"{total}Mi",
+                },
+                "capacity": {
+                    "cpu": cpu,
+                    "memory": mem,
+                    "pods": "110",
+                    "alibabacloud.com/gpu-count": str(count),
+                    "alibabacloud.com/gpu-mem": f"{total}Mi",
+                },
+            },
+        }
+    )
+
+
+def gpu_pod(name, mem_mib, count=1, cpu="1"):
+    return Pod.from_dict(
+        {
+            "metadata": {
+                "name": name,
+                "namespace": "default",
+                "annotations": {
+                    "alibabacloud.com/gpu-mem": f"{mem_mib}Mi",
+                    "alibabacloud.com/gpu-count": str(count),
+                },
+            },
+            "spec": {
+                "containers": [
+                    {"name": "c", "resources": {"requests": {"cpu": cpu}}}
+                ]
+            },
+        }
+    )
+
+
+def run_gpu(nodes, pods, placed=()):
+    enc = Encoder()
+    enc.register_pods(pods)
+    table = encode_nodes(
+        enc,
+        nodes,
+        existing_gpu=aggregate_gpu_usage(nodes, list(placed)),
+    )
+    batch = encode_pods(enc, pods)
+    ns = node_static_from_table(enc, table)
+    carry = carry_from_table(table, initial_selector_counts(enc, table, list(placed)))
+    rows = pod_rows_from_batch(batch)
+    final, placed_idx, reasons, take = schedule_batch(ns, carry, rows, weights_array())
+    names = [
+        table.names[int(i)] if int(i) >= 0 else None
+        for i in np.asarray(placed_idx)[: len(pods)]
+    ]
+    return names, np.asarray(reasons)[: len(pods)], np.asarray(take)[: len(pods)], final
+
+
+def ids_from_take(take_row):
+    return [d for d in range(len(take_row)) for _ in range(int(take_row[d]))]
+
+
+def test_single_gpu_tightest_fit():
+    # devices free: [16384, 8192(partially used), 24576] after seeding a pod
+    node = gpu_node("g0", 3, 16384)
+    seed = gpu_pod("seed", 8192)
+    seed.node_name = "g0"
+    seed.meta.annotations[ANNO_GPU_INDEX] = "1"
+    pod = gpu_pod("p", 4096)
+    names, _, take, _ = run_gpu([node], [pod], placed=[(seed, "g0")])
+    assert names == ["g0"]
+    # tightest fit: device 1 has 8192 free (least that still fits 4096)
+    assert ids_from_take(take[0]) == [1]
+
+
+def test_multi_gpu_two_pointer_packs_one_device():
+    # 2 devices x 20 GiB; request 3 shares of 8 GiB -> greedy packs dev0 twice
+    node = gpu_node("g0", 2, 20480)
+    pod = gpu_pod("p", 8192, count=3)
+    names, _, take, _ = run_gpu([node], [pod])
+    assert names == ["g0"]
+    assert ids_from_take(take[0]) == [0, 0, 1]
+
+
+def test_gpu_infeasible_when_no_device_fits():
+    # total free 20 GiB but no single device holds 12 GiB
+    node = gpu_node("g0", 2, 10240)
+    pod = gpu_pod("p", 12288)
+    names, reasons, _, _ = run_gpu([node], [pod])
+    assert names == [None]
+    assert reasons[0][F_GPU] == 1
+
+
+def test_gpu_pod_rejected_on_non_gpu_node():
+    plain = Node.from_dict(
+        {
+            "metadata": {"name": "cpu0"},
+            "status": {"allocatable": {"cpu": "32", "memory": "64Gi", "pods": "110"}},
+        }
+    )
+    pod = gpu_pod("p", 1024)
+    names, reasons, _, _ = run_gpu([plain], [pod])
+    assert names == [None]
+    assert reasons[0][F_GPU] == 1
+
+
+def test_sequential_packing_until_full():
+    # one node, 2 devices x 10 GiB; five 4-GiB pods: fits 2+2, fifth fails
+    node = gpu_node("g0", 2, 10240)
+    pods = [gpu_pod(f"p{i}", 4096) for i in range(5)]
+    names, reasons, take, _ = run_gpu([node], pods)
+    assert names[:4] == ["g0"] * 4
+    assert names[4] is None
+    assert reasons[4][F_GPU] == 1
+    per_dev = np.zeros(take.shape[1])
+    for row in take[:4]:
+        per_dev += row
+    assert sorted(per_dev[per_dev > 0].tolist()) == [2.0, 2.0]
+
+
+def test_whole_gpu_resource_uses_dynamic_count():
+    # 2 devices; a shared pod consumes ALL of one device, so only 1 device
+    # stays allocatable (GpuAllocatable subtracts fully-used devices,
+    # gpunodeinfo.go:355-362): a whole-GPU pod requesting 2 must fail even
+    # though the static allocatable says 2. A partially-used device would NOT
+    # reduce the count.
+    node = gpu_node("g0", 2, 16384)
+    shared = gpu_pod("shared", 16384)
+    whole = Pod.from_dict(
+        {
+            "metadata": {"name": "whole", "namespace": "default"},
+            "spec": {
+                "containers": [
+                    {
+                        "name": "c",
+                        "resources": {
+                            "requests": {"cpu": "1", "alibabacloud.com/gpu-count": "2"}
+                        },
+                    }
+                ]
+            },
+        }
+    )
+    names, reasons, _, _ = run_gpu([node], [shared, whole])
+    assert names[0] == "g0"
+    assert names[1] is None
+
+    # without the shared pod, the whole-GPU pod fits
+    names2, _, _, _ = run_gpu([gpu_node("g0", 2, 16384)], [whole])
+    assert names2 == ["g0"]
+
+    # a PARTIALLY-used device still counts as allocatable (reference quirk)
+    partial = gpu_pod("partial", 1024)
+    names3, _, _, _ = run_gpu([gpu_node("g0", 2, 16384)], [partial, whole])
+    assert names3 == ["g0", "g0"]
+
+
+def test_host_allocator_matches_kernel():
+    rng = np.random.default_rng(7)
+    for _ in range(50):
+        g = int(rng.integers(1, 6))
+        per_dev = float(rng.integers(4, 40) * 1024)
+        node = gpu_node("g0", g, int(per_dev))
+        mem = int(rng.integers(1, 20) * 512)
+        num = int(rng.integers(1, 5))
+        pod = gpu_pod("p", mem, count=num)
+        names, _, take, _ = run_gpu([node], [pod])
+        free = np.full(g, np.float32(per_dev), np.float32)
+        host_ids = host_allocate_gpu(free, np.float32(mem), num)
+        if host_ids is None:
+            assert names == [None]
+        else:
+            assert names == ["g0"]
+            assert ids_from_take(take[0]) == host_ids
+
+
+@pytest.mark.skipif(
+    not os.path.isdir(os.path.join(REF_EXAMPLE, "cluster/gpushare")),
+    reason="reference examples unavailable",
+)
+def test_reference_gpushare_example_end_to_end():
+    from open_simulator_tpu.utils.yamlio import objects_from_directory
+
+    cluster = ClusterResource.from_objects(
+        objects_from_directory(os.path.join(REF_EXAMPLE, "cluster/gpushare"))
+    )
+    app = AppResource(
+        name="gpushare",
+        objects=objects_from_directory(
+            os.path.join(REF_EXAMPLE, "application/gpushare")
+        ),
+    )
+    result = simulate(cluster, [app])
+    placed = [p for st in result.node_status for p in st.pods]
+    gpu_pods = [p for p in placed if p.gpu_mem_request() > 0]
+    # every scheduled GPU pod carries a device assignment
+    for p in gpu_pods:
+        assert p.meta.annotations.get(ANNO_GPU_INDEX), p.key
+    assert gpu_pods, "no GPU pods scheduled from the reference example"

@@ -18,6 +18,7 @@ from open_simulator_tpu.ops.encode import (
     initial_selector_counts,
 )
 from open_simulator_tpu.ops.kernels import (
+    F_POD_AFFINITY,
     F_RESOURCES,
     F_TAINT,
     NUM_FILTERS,
@@ -67,7 +68,7 @@ def encode_all(nodes, pods, placed=()):
 
 def run(nodes, pods, placed=()):
     enc, table, batch, ns, carry, rows = encode_all(nodes, pods, placed)
-    carry2, placed_idx, reasons = schedule_batch(ns, carry, rows, weights_array())
+    carry2, placed_idx, reasons, _ = schedule_batch(ns, carry, rows, weights_array())
     names = [table.names[i] if i >= 0 else None for i in np.asarray(placed_idx)[: len(pods)]]
     return names, np.asarray(reasons), np.asarray(carry2.free), table
 
@@ -283,7 +284,7 @@ def test_anti_affinity_spreads_replicas():
     # 3 replicas land on 3 distinct nodes; the 4th has nowhere left
     assert sorted(n for n in names[:3]) == ["n0", "n1", "n2"]
     assert names[3] is None
-    assert reasons[3][NUM_FILTERS - 1] == 3
+    assert reasons[3][F_POD_AFFINITY] == 3
 
 
 def test_required_pod_affinity_collocates():
@@ -401,6 +402,6 @@ def test_existing_pods_consume_free():
     ns = node_static_from_table(enc, table)
     carry = carry_from_table(table, initial_selector_counts(enc, table, [(existing, "a")]))
     rows = pod_rows_from_batch(batch)
-    _, placed, reasons = schedule_batch(ns, carry, rows, weights_array())
+    _, placed, reasons, _ = schedule_batch(ns, carry, rows, weights_array())
     assert np.asarray(placed)[0] == -1  # only 1 cpu free, pod wants 2
     assert np.asarray(reasons)[0][F_RESOURCES] == 1
