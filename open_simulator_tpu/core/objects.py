@@ -47,6 +47,24 @@ RESOURCE_GPU_COUNT = "alibabacloud.com/gpu-count"
 
 DEFAULT_SCHEDULER = "default-scheduler"
 
+# open-local / yoda storage-class name table (parity: pkg/utils/const.go:3-17).
+# LVM membership mirrors GetPodLocalPVCs (pkg/utils/utils.go:598-607): only the
+# two LVM class names route to the VG path; every other known class is an
+# exclusive-device request.
+LVM_SC_NAMES = {"open-local-lvm", "yoda-lvm-default"}
+SSD_SC_NAMES = {
+    "open-local-device-ssd",
+    "open-local-mountpoint-ssd",
+    "yoda-mountpoint-ssd",
+    "yoda-device-ssd",
+}
+HDD_SC_NAMES = {
+    "open-local-device-hdd",
+    "open-local-mountpoint-hdd",
+    "yoda-mountpoint-hdd",
+    "yoda-device-hdd",
+}
+
 
 def _canon_resources(res: Optional[dict], round_up: bool) -> Dict[str, int]:
     """Canonicalize a resource map. round_up for requests (conservative: a pod
@@ -325,6 +343,114 @@ def pod_limits_from_spec(spec: dict) -> Dict[str, int]:
     return total
 
 
+# ---------------------------------------------------------------------------
+# Open-Local storage model (parity: utils.NodeStorage/Volume/VolumeRequest,
+# pkg/utils/utils.go:510-530, and the open-local cache types
+# vendor/github.com/alibaba/open-local/pkg/scheduler/algorithm/cache/types.go:50-65)
+# ---------------------------------------------------------------------------
+
+def _parse_int_lenient(v, default: int = 0) -> int:
+    try:
+        return int(str(v))
+    except (TypeError, ValueError):
+        return default
+
+
+def _parse_bool_lenient(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    return str(v).strip().lower() == "true"
+
+
+@dataclass
+class LocalVG:
+    """A shared LVM volume group (SharedResource: json name/capacity/requested,
+    capacity & requested serialized as strings)."""
+    name: str
+    capacity: int       # bytes
+    requested: int = 0  # bytes already committed
+
+    @staticmethod
+    def from_dict(d: dict) -> "LocalVG":
+        return LocalVG(
+            name=str(d.get("name", "")),
+            capacity=_parse_int_lenient(d.get("capacity")),
+            requested=_parse_int_lenient(d.get("requested")),
+        )
+
+
+@dataclass
+class LocalDevice:
+    """An exclusive block device (ExclusiveResource: json name/device/capacity/
+    mediaType/isAllocated, the booleans serialized as strings)."""
+    name: str
+    capacity: int            # bytes
+    media_type: str = "hdd"  # "ssd" | "hdd"
+    is_allocated: bool = False
+
+    @staticmethod
+    def from_dict(d: dict) -> "LocalDevice":
+        return LocalDevice(
+            name=str(d.get("device") or d.get("name") or ""),
+            capacity=_parse_int_lenient(d.get("capacity")),
+            media_type=str(d.get("mediaType", "hdd")).lower(),
+            is_allocated=_parse_bool_lenient(d.get("isAllocated")),
+        )
+
+
+@dataclass
+class NodeLocalStorage:
+    """Decoded simon/node-local-storage annotation (utils.GetNodeStorage,
+    pkg/utils/utils.go:527-539)."""
+    vgs: List[LocalVG] = field(default_factory=list)
+    devices: List[LocalDevice] = field(default_factory=list)
+
+    @staticmethod
+    def from_json(s: str) -> Optional["NodeLocalStorage"]:
+        import json
+
+        try:
+            d = json.loads(s)
+        except (ValueError, TypeError):
+            return None
+        if not isinstance(d, dict):
+            return None
+        return NodeLocalStorage(
+            vgs=[LocalVG.from_dict(v) for v in d.get("vgs") or [] if isinstance(v, dict)],
+            devices=[
+                LocalDevice.from_dict(v)
+                for v in d.get("devices") or []
+                if isinstance(v, dict)
+            ],
+        )
+
+
+@dataclass
+class LocalVolume:
+    """One entry of the simon/pod-local-storage VolumeRequest (utils.Volume:
+    size serialized as string, kind in {LVM,SSD,HDD}, scName)."""
+    size: int      # bytes
+    kind: str
+    sc_name: str
+    vg_name: str = ""  # optional explicit VG (open-local's SC-parameter path)
+
+    @property
+    def is_lvm(self) -> bool:
+        return self.sc_name in LVM_SC_NAMES
+
+    @property
+    def media_type(self) -> str:
+        """Media type of a device request. The reference resolves it from the
+        StorageClass parameters (GetMediaTypeFromPVC); simon's SC name table
+        encodes it in the name, so we resolve from the name with the declared
+        volume kind as fallback."""
+        if self.sc_name in SSD_SC_NAMES or "ssd" in self.sc_name:
+            return "ssd"
+        if self.sc_name in HDD_SC_NAMES or "hdd" in self.sc_name:
+            return "hdd"
+        return "ssd" if self.kind.upper() == "SSD" else "hdd"
+
+
 @dataclass
 class Pod:
     meta: ObjectMeta
@@ -412,6 +538,37 @@ class Pod:
             pass
         return 0
 
+    def local_volumes(self) -> Tuple[List["LocalVolume"], List["LocalVolume"]]:
+        """(lvm_volumes, device_volumes) from the simon/pod-local-storage
+        annotation (parity: utils.GetPodLocalPVCs, pkg/utils/utils.go:580-625:
+        kind must be LVM/SSD/HDD; the two LVM storage-class names route to the
+        VG path, everything else is an exclusive device)."""
+        import json
+
+        s = self.meta.annotations.get(ANNO_POD_LOCAL_STORAGE)
+        if not s:
+            return [], []
+        try:
+            d = json.loads(s)
+        except (ValueError, TypeError):
+            return [], []
+        lvm: List[LocalVolume] = []
+        dev: List[LocalVolume] = []
+        for v in (d.get("volumes") or []) if isinstance(d, dict) else []:
+            if not isinstance(v, dict):
+                continue
+            kind = str(v.get("kind", ""))
+            if kind not in ("LVM", "SSD", "HDD"):
+                continue  # unsupported volume kind — reference logs and skips
+            vol = LocalVolume(
+                size=_parse_int_lenient(v.get("size")),
+                kind=kind,
+                sc_name=str(v.get("scName") or v.get("storageClassName") or ""),
+                vg_name=str(v.get("vgName", "")),
+            )
+            (lvm if vol.is_lvm else dev).append(vol)
+        return lvm, dev
+
     def gpu_index_ids(self) -> List[int]:
         """Allocated device ids from the gpu-index annotation, e.g. "2-3-4" ->
         [2,3,4] (parity: GpuIdStrToIntList, utils/pod.go:102-116). Duplicated
@@ -471,3 +628,12 @@ class Node:
         nodeGpuMem / gpuCount, pkg/type/open-gpu-share/cache/deviceinfo.go)."""
         c = self.gpu_count()
         return self.gpu_total_mem() // c if c > 0 else 0
+
+    def local_storage(self) -> Optional[NodeLocalStorage]:
+        """Decoded simon/node-local-storage annotation, or None when the node
+        has no local storage (parity: utils.GetNodeStorage/GetNodeCache,
+        pkg/utils/utils.go:527-563)."""
+        s = self.meta.annotations.get(ANNO_NODE_LOCAL_STORAGE)
+        if not s:
+            return None
+        return NodeLocalStorage.from_json(s)

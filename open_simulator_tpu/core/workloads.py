@@ -26,6 +26,9 @@ from .objects import (
     ANNO_WORKLOAD_KIND,
     ANNO_WORKLOAD_NAME,
     ANNO_WORKLOAD_NAMESPACE,
+    HDD_SC_NAMES,
+    LVM_SC_NAMES,
+    SSD_SC_NAMES,
     Node,
     Pod,
 )
@@ -42,21 +45,6 @@ CRONJOB = "CronJob"
 POD = "Pod"
 
 WORKLOAD_KINDS = {DEPLOYMENT, REPLICASET, STATEFULSET, DAEMONSET, JOB, CRONJOB, POD}
-
-# open-local / yoda storage-class name table (parity: pkg/utils/const.go:3-17)
-LVM_SC_NAMES = {"open-local-lvm", "yoda-lvm-default"}
-SSD_SC_NAMES = {
-    "open-local-device-ssd",
-    "open-local-mountpoint-ssd",
-    "yoda-mountpoint-ssd",
-    "yoda-device-ssd",
-}
-HDD_SC_NAMES = {
-    "open-local-device-hdd",
-    "open-local-mountpoint-hdd",
-    "yoda-mountpoint-hdd",
-    "yoda-device-hdd",
-}
 
 _rng = random.Random(0x51B0)
 
@@ -154,7 +142,9 @@ def _storage_annotation(volume_claim_templates: List[dict]) -> Optional[str]:
             kind = "HDD"
         else:
             continue  # unsupported storage class — reference logs an error
-        volumes.append({"size": size, "kind": kind, "storageClassName": sc})
+        # Field names/stringly size match the reference's ffjson encoding of
+        # utils.Volume (`json:"size,string"`, `json:"scName"`).
+        volumes.append({"size": str(size), "kind": kind, "scName": sc})
     if not volumes:
         return None
     return json.dumps({"volumes": volumes})

@@ -96,6 +96,79 @@ def placement_report(result: SimulateResult) -> str:
     return render_table(headers, rows)
 
 
+def storage_report(result: SimulateResult) -> str:
+    """Open-local view: per-node VG utilization and device allocation
+    (parity: the local-storage tables of reportExtendedResource,
+    apply.go:526-614)."""
+    if not result.storage:
+        return ""
+    headers = ["Node", "Resource", "Capacity", "Requested", "Util/Alloc"]
+    rows = []
+    for name in sorted(result.storage):
+        st = result.storage[name]
+        for vg in st.vgs:
+            rows.append(
+                [
+                    name,
+                    f"VG {vg.name}",
+                    format_bytes(vg.capacity),
+                    format_bytes(vg.requested),
+                    _pct(vg.requested, vg.capacity),
+                ]
+            )
+        for dev in st.devices:
+            rows.append(
+                [
+                    name,
+                    f"Device {dev.name} ({dev.media_type})",
+                    format_bytes(dev.capacity),
+                    "-",
+                    "allocated" if dev.is_allocated else "free",
+                ]
+            )
+    return render_table(headers, rows)
+
+
+def gpu_report(result: SimulateResult) -> str:
+    """GPU-share view: per-node per-device memory utilization from the bound
+    pods' gpu-index annotations (parity: the gpu tables of
+    reportExtendedResource, apply.go:616-687)."""
+    from ..core.objects import ANNO_GPU_INDEX  # noqa: F401 (doc pointer)
+
+    headers = ["Node", "GPU", "Mem Total", "Mem Used", "Util", "Pods"]
+    rows = []
+    for st in sorted(result.node_status, key=lambda s: s.node.name):
+        node = st.node
+        count = node.gpu_count()
+        if count <= 0:
+            continue
+        per_dev = node.gpu_mem_per_device()
+        used = [0] * count
+        pods_on = [0] * count
+        for pod in st.pods:
+            mem = pod.gpu_mem_request()
+            if mem <= 0:
+                continue
+            for d in pod.gpu_index_ids():
+                if 0 <= d < count:
+                    used[d] += mem
+                    pods_on[d] += 1
+        for d in range(count):
+            rows.append(
+                [
+                    node.name,
+                    f"gpu-{d}",
+                    format_bytes(per_dev),
+                    format_bytes(used[d]),
+                    _pct(used[d], per_dev),
+                    pods_on[d],
+                ]
+            )
+    if not rows:
+        return ""
+    return render_table(headers, rows)
+
+
 def unscheduled_report(result: SimulateResult) -> str:
     if not result.unscheduled:
         return "All pods scheduled."
@@ -104,14 +177,19 @@ def unscheduled_report(result: SimulateResult) -> str:
     return render_table(headers, rows)
 
 
-def full_report(result: SimulateResult) -> str:
-    return "\n\n".join(
-        [
-            "=== Cluster ===",
-            cluster_report(result),
-            "=== Placements ===",
-            placement_report(result),
-            "=== Unscheduled ===",
-            unscheduled_report(result),
-        ]
-    )
+def full_report(result: SimulateResult, extended: bool = True) -> str:
+    parts = [
+        "=== Cluster ===",
+        cluster_report(result),
+        "=== Placements ===",
+        placement_report(result),
+    ]
+    if extended:
+        stor = storage_report(result)
+        if stor:
+            parts += ["=== Local Storage ===", stor]
+        gpu = gpu_report(result)
+        if gpu:
+            parts += ["=== GPU Share ===", gpu]
+    parts += ["=== Unscheduled ===", unscheduled_report(result)]
+    return "\n\n".join(parts)

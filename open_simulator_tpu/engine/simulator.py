@@ -28,7 +28,10 @@ from ..core.objects import (
     ANNO_GPU_INDEX,
     ANNO_NODE_LOCAL_STORAGE,
     DEFAULT_SCHEDULER,
+    LocalDevice,
+    LocalVG,
     Node,
+    NodeLocalStorage,
     Pod,
 )
 from ..core.workloads import WORKLOAD_KINDS, pods_from_workload
@@ -109,6 +112,11 @@ class NodeStatus:
 class SimulateResult:
     unscheduled: List[UnscheduledPod] = field(default_factory=list)
     node_status: List[NodeStatus] = field(default_factory=list)
+    # Post-simulation open-local state per node (the reference mutates the
+    # node annotation on every storage Bind; here the device carry holds the
+    # truth and is decoded once at the end): node name -> NodeLocalStorage
+    # with updated vg.requested / device.is_allocated.
+    storage: Dict[str, NodeLocalStorage] = field(default_factory=dict)
 
     def pods_on(self, node_name: str) -> List[Pod]:
         for st in self.node_status:
@@ -250,7 +258,41 @@ class Simulator:
             if node_name in by_node:
                 by_node[node_name].pods.append(pod)
         result.node_status = list(by_node.values())
+        result.storage = self._storage_status()
         return result
+
+    def _storage_status(self) -> Dict[str, NodeLocalStorage]:
+        """Decode the final vg_free/dev_free carry back into per-node storage
+        state (parity: the bind-updated simon/node-local-storage annotations,
+        plugin/open-local.go:221-247)."""
+        out: Dict[str, NodeLocalStorage] = {}
+        if self._carry is None:
+            return out
+        vg_free = np.asarray(self._carry.vg_free)
+        dev_free = np.asarray(self._carry.dev_free)
+        for i, node in enumerate(self.cluster.nodes):
+            st = node.local_storage()
+            if st is None:
+                continue
+            vgs = [
+                LocalVG(
+                    name=vg.name,
+                    capacity=vg.capacity,
+                    requested=vg.capacity - int(vg_free[i, j]) * (1 << 20),
+                )
+                for j, vg in enumerate(st.vgs[: vg_free.shape[1]])
+            ]
+            devs = [
+                LocalDevice(
+                    name=d.name,
+                    capacity=d.capacity,
+                    media_type=d.media_type,
+                    is_allocated=dev_free[i, j] < 0.5,
+                )
+                for j, d in enumerate(st.devices[: dev_free.shape[1]])
+            ]
+            out[node.name] = NodeLocalStorage(vgs=vgs, devices=devs)
+        return out
 
 
 def simulate(

@@ -31,6 +31,8 @@ PLUGIN_NAME_MAP = {
     "InterPodAffinity": "inter_pod_affinity",
     "NodePreferAvoidPods": "prefer_avoid_pods",
     "Simon": "simon",
+    "Open-Local": "open_local",
+    "Open-Gpu-Share": "gpu_share",
     # score-neutral in a fake cluster (no images, see SURVEY §2.2): accepted
     # and ignored so reference configs parse cleanly
     "ImageLocality": None,

@@ -125,6 +125,7 @@ class Encoder:
         self.empty_val_id = self.vals.id("")
         self.pairs = Vocab()       # "key=value"
         self.names = Vocab()       # node names
+        self.vgs = Vocab()         # LVM volume-group names (open-local)
         self.resources: List[str] = ["cpu", "memory", "pods", RESOURCE_GPU_COUNT]
         assert self.resources[GPU_COUNT_IDX] == RESOURCE_GPU_COUNT
         # kubernetes.io/hostname is pinned at index 0: its domains are the
@@ -216,6 +217,16 @@ class NodeTable:
     valid: np.ndarray       # bool[N]
     gpu_total: np.ndarray   # f32[N,G] per-device total GPU mem, MiB (0 = none)
     gpu_free: np.ndarray    # f32[N,G] per-device free after existing pods
+    # open-local storage (parity: the simon/node-local-storage annotation,
+    # utils.GetNodeStorage — VGs are shared bin-packed pools, devices are
+    # exclusively allocated whole disks)
+    vg_cap: np.ndarray      # f32[N,V] VG capacity, MiB (0 = pad)
+    vg_free: np.ndarray     # f32[N,V] capacity - requested
+    vg_name: np.ndarray     # i32[N,V] VG name vocab id (0 = pad)
+    dev_cap: np.ndarray     # f32[N,DV] device capacity, MiB (0 = pad)
+    dev_ssd: np.ndarray     # bool[N,DV] media type is SSD
+    dev_free: np.ndarray    # f32[N,DV] 1.0 = free, 0.0 = allocated/pad
+    has_storage: np.ndarray  # bool[N] node carries the storage annotation
     names: List[str] = field(default_factory=list)
 
     @property
@@ -262,6 +273,12 @@ class PodBatch:
     aff_anti: np.ndarray       # bool[P,A]
     aff_required: np.ndarray   # bool[P,A]
     aff_weight: np.ndarray     # f32[P,A] (preferred terms; 0 for required)
+    # open-local storage volumes (parity: simon/pod-local-storage VolumeRequest)
+    lvm_req: np.ndarray        # f32[P,SV] LVM request MiB per slot (0 = pad)
+    lvm_vg: np.ndarray         # i32[P,SV] explicit VG id, 0 = binpack over VGs
+    dev_req: np.ndarray        # f32[P,SV] exclusive-device request MiB (0 = pad)
+    dev_media_ssd: np.ndarray  # bool[P,SV] device request wants SSD media
+    has_local: np.ndarray      # bool[P] pod carries any local-storage volume
     # membership of this pod in each deduped selector
     match_sel: np.ndarray      # bool[P,S]
     owned_by_rs: np.ndarray    # bool[P] controller is ReplicaSet/RC (NodePreferAvoidPods)
@@ -297,6 +314,9 @@ def encode_nodes(
     T = round_up(max((len(nd.taints) for nd in nodes), default=1), 2)
     K = max(len(enc.topology_keys), 1)
     G = round_up(max((nd.gpu_count() for nd in nodes), default=1), 2)
+    storages = [nd.local_storage() for nd in nodes]
+    V = round_up(max((len(s.vgs) for s in storages if s), default=1), 2)
+    DV = round_up(max((len(s.devices) for s in storages if s), default=1), 2)
 
     alloc = np.zeros((N, R), np.float32)
     free = np.zeros((N, R), np.float32)
@@ -313,6 +333,13 @@ def encode_nodes(
     valid = np.zeros(N, bool)
     gpu_total = np.zeros((N, G), np.float32)
     gpu_free = np.zeros((N, G), np.float32)
+    vg_cap = np.zeros((N, V), np.float32)
+    vg_free = np.zeros((N, V), np.float32)
+    vg_name = np.zeros((N, V), np.int32)
+    dev_cap = np.zeros((N, DV), np.float32)
+    dev_ssd = np.zeros((N, DV), bool)
+    dev_free = np.zeros((N, DV), np.float32)
+    has_storage = np.zeros(N, bool)
 
     usage = existing_usage or {}
     gpu_usage = existing_gpu or {}
@@ -351,6 +378,19 @@ def encode_nodes(
             used = gpu_usage.get(nd.name)
             if used is not None:
                 gpu_free[i, : len(used)] -= used.astype(np.float32)
+        st = storages[i]
+        if st is not None:
+            has_storage[i] = True
+            for j, vg in enumerate(st.vgs[:V]):
+                vg_name[i, j] = enc.vgs.id(vg.name)
+                vg_cap[i, j] = np.float32(vg.capacity / float(1 << 20))
+                vg_free[i, j] = np.float32(
+                    max(vg.capacity - vg.requested, 0) / float(1 << 20)
+                )
+            for j, dev in enumerate(st.devices[:DV]):
+                dev_cap[i, j] = np.float32(dev.capacity / float(1 << 20))
+                dev_ssd[i, j] = dev.media_type == "ssd"
+                dev_free[i, j] = 0.0 if dev.is_allocated else 1.0
 
     return NodeTable(
         alloc=alloc, free=free, label_pair=label_pair, label_key=label_key,
@@ -358,6 +398,9 @@ def encode_nodes(
         taint_effect=taint_effect, name_id=name_id, unsched=unsched,
         avoid_pods=avoid, topo=topo, valid=valid,
         gpu_total=gpu_total, gpu_free=gpu_free,
+        vg_cap=vg_cap, vg_free=vg_free, vg_name=vg_name,
+        dev_cap=dev_cap, dev_ssd=dev_ssd, dev_free=dev_free,
+        has_storage=has_storage,
         names=[nd.name for nd in nodes],
     )
 
@@ -440,6 +483,8 @@ def encode_pods(
         ),
         1,
     )
+    vols = [pd.local_volumes() for pd in pods]
+    SV = round_up(max((max(len(l), len(d)) for l, d in vols), default=1), 2)
 
     b = PodBatch(
         req=np.zeros((P, R), np.float32),
@@ -472,6 +517,11 @@ def encode_pods(
         aff_anti=np.zeros((P, A), bool),
         aff_required=np.zeros((P, A), bool),
         aff_weight=np.zeros((P, A), np.float32),
+        lvm_req=np.zeros((P, SV), np.float32),
+        lvm_vg=np.zeros((P, SV), np.int32),
+        dev_req=np.zeros((P, SV), np.float32),
+        dev_media_ssd=np.zeros((P, SV), bool),
+        has_local=np.zeros(P, bool),
         match_sel=np.zeros((P, S), bool),
         owned_by_rs=np.zeros(P, bool),
         valid=np.zeros(P, bool),
@@ -528,6 +578,21 @@ def encode_pods(
             b.aff_weight[i, j] = weight
         for s, entry in enumerate(enc.selectors):
             b.match_sel[i, s] = entry.matches(pod)
+        lvm_vols, dev_vols = vols[i]
+        b.has_local[i] = bool(lvm_vols or dev_vols)
+        # Explicit-VG volumes are allocated before binpack volumes, each class
+        # in annotation order (ProcessLVMPVCPredicate handles pvcsWithVG first,
+        # algo/common.go:59-75); device volumes are sorted ascending by size —
+        # the reference sorts each media class ascending before the greedy
+        # match (CheckExclusiveResourceMeetsPVCSize, algo/common.go:291-294),
+        # and a stable ascending sort of the union preserves per-media order.
+        lvm_vols = sorted(lvm_vols, key=lambda x: not x.vg_name)
+        for j, v in enumerate(lvm_vols[:SV]):
+            b.lvm_req[i, j] = np.float32(v.size / float(1 << 20))
+            b.lvm_vg[i, j] = enc.vgs.id(v.vg_name) if v.vg_name else 0
+        for j, v in enumerate(sorted(dev_vols, key=lambda x: x.size)[:SV]):
+            b.dev_req[i, j] = np.float32(v.size / float(1 << 20))
+            b.dev_media_ssd[i, j] = v.media_type == "ssd"
 
     return b
 

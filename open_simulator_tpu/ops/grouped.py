@@ -37,6 +37,7 @@ from .kernels import (
     F_POD_AFFINITY,
     F_RESOURCES,
     F_SPREAD,
+    F_STORAGE,
     F_TAINT,
     F_UNSCHEDULABLE,
     NUM_FILTERS,
@@ -44,8 +45,10 @@ from .kernels import (
     PodRow,
     WEIGHT_ORDER,
     _EPS,
+    _minmax_normalize,
     gpu_allocate,
     gpu_mask,
+    local_storage_eval,
     node_affinity_mask,
     pod_affinity_mask,
     resource_fail,
@@ -114,8 +117,12 @@ def schedule_group(
         res_fail = resource_fail(ns, c, pod)
         spread_ok = spread_mask(ns, c, pod)
         aff_ok = pod_affinity_mask(ns, c, pod)
+        storage_ok, vg_take, dev_take, storage_raw = local_storage_eval(ns, c, pod)
         gpu_ok = gpu_mask(ns, c, pod)
-        mask = static_ok & ~res_fail & spread_ok & aff_ok & gpu_ok & ns.valid
+        mask = (
+            static_ok & ~res_fail & spread_ok & aff_ok & storage_ok & gpu_ok
+            & ns.valid
+        )
 
         # Stack in WEIGHT_ORDER exactly like run_scores so the f32 summation
         # order (and therefore every tie-break) matches the naive kernel.
@@ -125,6 +132,9 @@ def schedule_group(
             "topology_spread": score_topology_spread(ns, c, pod),
             "inter_pod_affinity": score_inter_pod_affinity(ns, c, pod),
             "gpu_share": score_gpu_share(ns, c, pod),
+            "open_local": jnp.where(
+                pod.has_local, _minmax_normalize(storage_raw, ns.valid), 0.0
+            ),
             **static_scores,
         }
         stacked = jnp.stack([by_name[k] for k in WEIGHT_ORDER], axis=0)
@@ -141,6 +151,9 @@ def schedule_group(
             * onehot.astype(jnp.float32)[None, :]
         )
         gpu_take, gpu_free = gpu_allocate(ns, c, pod, onehot)
+        sel_f = onehot.astype(jnp.float32)[:, None]
+        vg_free = c.vg_free - sel_f * vg_take
+        dev_free = c.dev_free - sel_f * dev_take
 
         first_fail = jnp.where(
             static_ff < NUM_FILTERS,
@@ -154,7 +167,11 @@ def schedule_group(
                     jnp.where(
                         ~aff_ok,
                         F_POD_AFFINITY,
-                        jnp.where(~gpu_ok, F_GPU, NUM_FILTERS),
+                        jnp.where(
+                            ~storage_ok,
+                            F_STORAGE,
+                            jnp.where(~gpu_ok, F_GPU, NUM_FILTERS),
+                        ),
                     ),
                 ),
             ),
@@ -164,7 +181,10 @@ def schedule_group(
         ].add(jnp.where((first_fail < NUM_FILTERS) & ns.valid, 1, 0))
         reason_counts = jnp.where(ok, jnp.zeros_like(reason_counts), reason_counts)
 
-        return Carry(free=free, sel_counts=sel_counts, gpu_free=gpu_free), (
+        return Carry(
+            free=free, sel_counts=sel_counts, gpu_free=gpu_free,
+            vg_free=vg_free, dev_free=dev_free,
+        ), (
             node_out.astype(jnp.int32),
             reason_counts,
             gpu_take.astype(jnp.int32),

@@ -47,8 +47,9 @@ F_NODE_AFFINITY = 3
 F_RESOURCES = 4
 F_SPREAD = 5
 F_POD_AFFINITY = 6
-F_GPU = 7
-NUM_FILTERS = 8
+F_STORAGE = 7
+F_GPU = 8
+NUM_FILTERS = 9
 
 FILTER_MESSAGES = (
     "node(s) were unschedulable",
@@ -58,6 +59,7 @@ FILTER_MESSAGES = (
     "Insufficient resources",
     "node(s) didn't match pod topology spread constraints",
     "node(s) didn't match pod affinity/anti-affinity rules",
+    "node(s) didn't have enough local storage",
     "node(s) didn't have enough free GPU memory",
 )
 
@@ -73,6 +75,7 @@ DEFAULT_WEIGHTS = {
     "prefer_avoid_pods": 10000.0,
     "simon": 1.0,
     "gpu_share": 1.0,
+    "open_local": 1.0,
 }
 WEIGHT_ORDER = tuple(sorted(DEFAULT_WEIGHTS))
 
@@ -96,6 +99,11 @@ class NodeStatic(NamedTuple):
     topo: jnp.ndarray         # i32[N,K] domain id or -1
     valid: jnp.ndarray        # bool[N]
     gpu_total: jnp.ndarray    # f32[N,G] per-device total GPU mem MiB (0=none)
+    vg_cap: jnp.ndarray       # f32[N,V] open-local VG capacity MiB (0=pad)
+    vg_name: jnp.ndarray      # i32[N,V] VG name id (0=pad)
+    dev_cap: jnp.ndarray      # f32[N,DV] exclusive-device capacity MiB (0=pad)
+    dev_ssd: jnp.ndarray      # bool[N,DV] device media is SSD
+    has_storage: jnp.ndarray  # bool[N] node carries local storage
     domain_key: jnp.ndarray   # i32[D] topo-key index per domain id (-1 pad)
     topo_onehot: jnp.ndarray  # f32[K,D,N] domain membership (0 for missing key)
     unsched_key_id: jnp.ndarray  # i32 scalar: key id of node.kubernetes.io/unschedulable
@@ -109,6 +117,8 @@ class Carry(NamedTuple):
     gpu_free: jnp.ndarray    # f32[N,G] per-device free GPU mem MiB
                              # (tracks annotation pods only, like the
                              # reference's SchedulerCache)
+    vg_free: jnp.ndarray     # f32[N,V] VG capacity - requested, MiB
+    dev_free: jnp.ndarray    # f32[N,DV] 1.0 = device free, 0.0 = allocated
 
 
 class PodRow(NamedTuple):
@@ -143,6 +153,11 @@ class PodRow(NamedTuple):
     aff_anti: jnp.ndarray
     aff_required: jnp.ndarray
     aff_weight: jnp.ndarray
+    lvm_req: jnp.ndarray
+    lvm_vg: jnp.ndarray
+    dev_req: jnp.ndarray
+    dev_media_ssd: jnp.ndarray
+    has_local: jnp.ndarray
     match_sel: jnp.ndarray
     owned_by_rs: jnp.ndarray
     valid: jnp.ndarray
@@ -382,6 +397,153 @@ def gpu_allocate(
     return take, gpu_free
 
 
+# ---------------------------------------------------------------------------
+# Open-Local: LVM volume-group binpack + exclusive-device allocation
+# (parity: pkg/simulator/plugin/open-local.go + the vendored algorithms at
+#  vendor/github.com/alibaba/open-local/pkg/scheduler/algorithm/algo/common.go —
+#  ProcessLVMPVCPredicate :59, ProcessDevicePVC :394, ScoreLVM :660,
+#  ScoreDevice :753, and the Bind-side commit open-local.go:175-254)
+# ---------------------------------------------------------------------------
+
+def local_storage_eval(ns: NodeStatic, carry: Carry, pod: PodRow):
+    """Simulate this pod's storage allocation on EVERY node at once.
+
+    Returns (ok bool[N], vg_take f32[N,V] MiB claimed per VG, dev_take
+    f32[N,DV] one-hot devices claimed, raw_score f32[N] — the plugin's
+    pre-normalize 0..20 score).
+
+    LVM volumes without an explicit VG follow the default Binpack strategy:
+    each request goes to the VG with the least free space that still fits
+    (common.go:575-618 sorts ascending and takes the first fit; ties break to
+    the lowest VG index here where Go's unstable sort is arbitrary). Explicit
+    VG requests must fit that VG (common.go:59-96). Device volumes take the
+    smallest free device of the right media type whose capacity covers the
+    request — the ascending device walk of CheckExclusiveResourceMeetsPVCSize
+    (common.go:290-350) picks exactly that device for requests sorted
+    ascending, which the encoder guarantees.
+    """
+    N, V = ns.vg_cap.shape
+    DV = ns.dev_cap.shape[1]
+    SV = pod.lvm_req.shape[0]
+
+    def lvm_slot(state, s):
+        vg_free, vg_take, ok = state
+        req = pod.lvm_req[s]
+        active = req > 0
+        want = pod.lvm_vg[s]
+        fits = (vg_free + _EPS >= req) & (ns.vg_name != 0)       # [N,V]
+        elig = jnp.where(want != 0, fits & (ns.vg_name == want), fits)
+        free_key = jnp.where(elig, vg_free, jnp.inf)
+        choice = jnp.argmin(free_key, axis=1)                     # [N]
+        any_elig = jnp.any(elig, axis=1)
+        onehot = (
+            (jnp.arange(V)[None, :] == choice[:, None])
+            & any_elig[:, None]
+            & active
+        ).astype(jnp.float32)
+        return (
+            vg_free - onehot * req,
+            vg_take + onehot * req,
+            ok & (any_elig | ~active),
+        ), None
+
+    (_, vg_take, lvm_ok), _ = jax.lax.scan(
+        lvm_slot,
+        (carry.vg_free, jnp.zeros_like(carry.vg_free), jnp.ones(N, bool)),
+        jnp.arange(SV),
+    )
+
+    def dev_slot(state, s):
+        avail, dev_take, frac_sum, ok = state
+        req = pod.dev_req[s]
+        active = req > 0
+        elig = (
+            (avail > 0.5)
+            & (ns.dev_ssd == pod.dev_media_ssd[s])
+            & (ns.dev_cap + _EPS >= req)
+            & (ns.dev_cap > 0)
+        )                                                          # [N,DV]
+        cap_key = jnp.where(elig, ns.dev_cap, jnp.inf)
+        choice = jnp.argmin(cap_key, axis=1)
+        any_elig = jnp.any(elig, axis=1)
+        onehot = (
+            (jnp.arange(DV)[None, :] == choice[:, None])
+            & any_elig[:, None]
+            & active
+        ).astype(jnp.float32)
+        cap_chosen = jnp.sum(onehot * ns.dev_cap, axis=1)          # [N]
+        frac_sum = frac_sum + jnp.where(
+            any_elig & active, req / jnp.maximum(cap_chosen, 1e-9), 0.0
+        )
+        return (
+            avail - onehot,
+            dev_take + onehot,
+            frac_sum,
+            ok & (any_elig | ~active),
+        ), None
+
+    (_, dev_take, dev_frac_sum, dev_ok), _ = jax.lax.scan(
+        dev_slot,
+        (
+            carry.dev_free,
+            jnp.zeros_like(carry.dev_free),
+            jnp.zeros(N, jnp.float32),
+            jnp.ones(N, bool),
+        ),
+        jnp.arange(SV),
+    )
+
+    ok = jnp.where(
+        pod.has_local,
+        lvm_ok & dev_ok & ns.has_storage,
+        jnp.ones(N, bool),
+    )
+
+    # ScoreLVM (Binpack): mean over the VGs this pod uses of used/capacity,
+    # ×10, floor'd (common.go:660-684). ScoreDevice: mean over units of
+    # requested/allocated-capacity, ×10, floor'd (common.go:753-762). The
+    # plugin returns their sum (open-local.go:136) before its min-max
+    # NormalizeScore maps the batch to 0..100 (open-local.go:145-170).
+    used = vg_take > 0
+    vg_frac = jnp.where(used, vg_take / jnp.maximum(ns.vg_cap, 1e-9), 0.0)
+    lvm_cnt = jnp.sum(used.astype(jnp.float32), axis=1)
+    lvm_score = jnp.floor(
+        jnp.where(
+            lvm_cnt > 0,
+            jnp.sum(vg_frac, axis=1) / jnp.maximum(lvm_cnt, 1.0) * 10.0,
+            0.0,
+        )
+    )
+    dev_cnt = jnp.sum((pod.dev_req > 0).astype(jnp.float32))
+    dev_score = jnp.floor(
+        jnp.where(dev_cnt > 0, dev_frac_sum / jnp.maximum(dev_cnt, 1.0) * 10.0, 0.0)
+    )
+    raw = jnp.where(ok & pod.has_local, lvm_score + dev_score, 0.0)
+    return ok, vg_take, dev_take, raw
+
+
+def local_storage_mask(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
+    ok, _, _, _ = local_storage_eval(ns, carry, pod)
+    return ok
+
+
+def score_open_local(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
+    """Open-Local Score + its NormalizeScore. Pods without storage volumes get
+    MinScore everywhere (open-local.go:113-119), which normalizes to 0."""
+    _, _, _, raw = local_storage_eval(ns, carry, pod)
+    return jnp.where(pod.has_local, _minmax_normalize(raw, ns.valid), 0.0)
+
+
+def local_storage_commit(
+    ns: NodeStatic, carry: Carry, pod: PodRow, node_onehot: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Commit the chosen node's storage allocation (the Bind-side annotation
+    rewrite, open-local.go:221-247): VG requested += size, device allocated."""
+    _, vg_take, dev_take, _ = local_storage_eval(ns, carry, pod)
+    sel = node_onehot.astype(jnp.float32)[:, None]
+    return carry.vg_free - sel * vg_take, carry.dev_free - sel * dev_take
+
+
 def resource_fail(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
     """NodeResourcesFit failure -> bool[N]. The whole-GPU extended resource
     (alibabacloud.com/gpu-count) is checked against its DYNAMIC allocatable —
@@ -419,6 +581,7 @@ def run_filters(ns: NodeStatic, carry: Carry, pod: PodRow):
             resource_fail(ns, carry, pod),
             ~spread_mask(ns, carry, pod),
             ~pod_affinity_mask(ns, carry, pod),
+            ~local_storage_mask(ns, carry, pod),
             ~gpu_mask(ns, carry, pod),
         ],
         axis=1,
@@ -588,6 +751,7 @@ def run_scores(ns: NodeStatic, carry: Carry, pod: PodRow, weights: jnp.ndarray) 
         "prefer_avoid_pods": score_prefer_avoid(ns, pod),
         "simon": score_simon(ns, carry, pod),
         "gpu_share": score_gpu_share(ns, carry, pod),
+        "open_local": score_open_local(ns, carry, pod),
     }
     stacked = jnp.stack([by_name[k] for k in WEIGHT_ORDER], axis=0)  # [W,N]
     return jnp.sum(stacked * weights[:, None], axis=0)
@@ -611,13 +775,17 @@ def schedule_step(ns: NodeStatic, weights: jnp.ndarray, carry: Carry, pod: PodRo
         pod.match_sel.astype(jnp.float32)[:, None] * onehot.astype(jnp.float32)[None, :]
     )
     gpu_take, gpu_free = gpu_allocate(ns, carry, pod, onehot)
+    vg_free, dev_free = local_storage_commit(ns, carry, pod, onehot)
 
     reason_counts = jnp.zeros(NUM_FILTERS, jnp.int32).at[
         jnp.clip(first_fail, 0, NUM_FILTERS - 1)
     ].add(jnp.where((first_fail < NUM_FILTERS) & ns.valid, 1, 0))
     reason_counts = jnp.where(ok, jnp.zeros_like(reason_counts), reason_counts)
 
-    new_carry = Carry(free=free, sel_counts=sel_counts, gpu_free=gpu_free)
+    new_carry = Carry(
+        free=free, sel_counts=sel_counts, gpu_free=gpu_free,
+        vg_free=vg_free, dev_free=dev_free,
+    )
     return new_carry, (
         node_out.astype(jnp.int32),
         reason_counts,

@@ -47,6 +47,11 @@ def node_static_from_table(enc: Encoder, table: NodeTable) -> NodeStatic:
         topo=jnp.asarray(table.topo),
         valid=jnp.asarray(table.valid),
         gpu_total=jnp.asarray(table.gpu_total),
+        vg_cap=jnp.asarray(table.vg_cap),
+        vg_name=jnp.asarray(table.vg_name),
+        dev_cap=jnp.asarray(table.dev_cap),
+        dev_ssd=jnp.asarray(table.dev_ssd),
+        has_storage=jnp.asarray(table.has_storage),
         domain_key=jnp.asarray(domain_key),
         topo_onehot=jnp.asarray(topo_onehot),
         unsched_key_id=jnp.int32(enc.unsched_key_id),
@@ -63,6 +68,8 @@ def carry_from_table(
         free=jnp.asarray(table.free),
         sel_counts=jnp.asarray(sel_counts),
         gpu_free=jnp.asarray(table.gpu_free),
+        vg_free=jnp.asarray(table.vg_free),
+        dev_free=jnp.asarray(table.dev_free),
     )
 
 
@@ -99,6 +106,11 @@ def pod_rows_from_batch(batch: PodBatch) -> PodRow:
         aff_anti=jnp.asarray(batch.aff_anti),
         aff_required=jnp.asarray(batch.aff_required),
         aff_weight=jnp.asarray(batch.aff_weight),
+        lvm_req=jnp.asarray(batch.lvm_req),
+        lvm_vg=jnp.asarray(batch.lvm_vg),
+        dev_req=jnp.asarray(batch.dev_req),
+        dev_media_ssd=jnp.asarray(batch.dev_media_ssd),
+        has_local=jnp.asarray(batch.has_local),
         match_sel=jnp.asarray(batch.match_sel),
         owned_by_rs=jnp.asarray(batch.owned_by_rs),
         valid=jnp.asarray(batch.valid),
@@ -112,4 +124,4 @@ def align_sel_counts(carry: Carry, num_selectors: int) -> Carry:
     if S <= S_old:
         return carry
     grown = jnp.zeros((S, N), jnp.float32).at[:S_old].set(carry.sel_counts)
-    return Carry(free=carry.free, sel_counts=grown, gpu_free=carry.gpu_free)
+    return carry._replace(sel_counts=grown)

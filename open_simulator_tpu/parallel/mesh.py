@@ -48,6 +48,11 @@ def node_sharding(mesh: Mesh) -> NodeStatic:
         topo=s(NODE_AXIS, None),
         valid=s(NODE_AXIS),
         gpu_total=s(NODE_AXIS, None),
+        vg_cap=s(NODE_AXIS, None),
+        vg_name=s(NODE_AXIS, None),
+        dev_cap=s(NODE_AXIS, None),
+        dev_ssd=s(NODE_AXIS, None),
+        has_storage=s(NODE_AXIS),
         domain_key=s(None),      # small, replicated
         topo_onehot=s(None, None, NODE_AXIS),
         unsched_key_id=s(),
@@ -61,6 +66,8 @@ def carry_sharding(mesh: Mesh) -> Carry:
         free=s(NODE_AXIS, None),
         sel_counts=s(None, NODE_AXIS),
         gpu_free=s(NODE_AXIS, None),
+        vg_free=s(NODE_AXIS, None),
+        dev_free=s(NODE_AXIS, None),
     )
 
 

@@ -1,0 +1,411 @@
+"""Open-Local storage plugin: LVM VG binpack + exclusive-device allocation.
+
+Parity targets:
+  - Filter/Score/Bind: /root/reference/pkg/simulator/plugin/open-local.go
+  - ProcessLVMPVCPredicate / Binpack / ProcessDevicePVC / ScoreLVM / ScoreDevice:
+    vendor/github.com/alibaba/open-local/pkg/scheduler/algorithm/algo/common.go
+  - annotation codecs: pkg/utils/utils.go:510-625
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from open_simulator_tpu.core.objects import (
+    ANNO_NODE_LOCAL_STORAGE,
+    ANNO_POD_LOCAL_STORAGE,
+    Node,
+    NodeLocalStorage,
+    Pod,
+)
+from open_simulator_tpu.engine.simulator import (
+    AppResource,
+    ClusterResource,
+    simulate,
+)
+from open_simulator_tpu.ops.encode import (
+    Encoder,
+    encode_nodes,
+    encode_pods,
+    initial_selector_counts,
+)
+from open_simulator_tpu.ops.kernels import (
+    F_STORAGE,
+    schedule_batch,
+    weights_array,
+)
+from open_simulator_tpu.ops.state import (
+    carry_from_table,
+    node_static_from_table,
+    pod_rows_from_batch,
+)
+
+GiB = 1 << 30
+
+
+def storage_node(name, vgs=(), devices=(), cpu="8", mem="16Gi"):
+    d = {
+        "metadata": {"name": name},
+        "status": {"allocatable": {"cpu": cpu, "memory": mem, "pods": "110"}},
+    }
+    node = Node.from_dict(d)
+    if vgs or devices:
+        node.meta.annotations[ANNO_NODE_LOCAL_STORAGE] = json.dumps(
+            {
+                "vgs": [
+                    {"name": n, "capacity": str(c), "requested": str(r)}
+                    for n, c, r in vgs
+                ],
+                "devices": [
+                    {
+                        "name": n,
+                        "device": n,
+                        "capacity": str(c),
+                        "mediaType": m,
+                        "isAllocated": a,
+                    }
+                    for n, c, m, a in devices
+                ],
+            }
+        )
+    return node
+
+
+def storage_pod(name, volumes):
+    return Pod.from_dict(
+        {
+            "metadata": {
+                "name": name,
+                "namespace": "stor",
+                "annotations": {
+                    ANNO_POD_LOCAL_STORAGE: json.dumps({"volumes": volumes})
+                },
+            },
+            "spec": {
+                "containers": [
+                    {
+                        "name": "c",
+                        "resources": {
+                            "requests": {"cpu": "100m", "memory": "128Mi"}
+                        },
+                    }
+                ]
+            },
+        }
+    )
+
+
+def lvm_vol(size, sc="open-local-lvm", vg=""):
+    v = {"size": str(size), "kind": "LVM", "scName": sc}
+    if vg:
+        v["vgName"] = vg
+    return v
+
+
+def dev_vol(size, media="ssd"):
+    kind = media.upper()
+    return {
+        "size": str(size),
+        "kind": kind,
+        "scName": f"open-local-device-{media}",
+    }
+
+
+def run_batch(nodes, pods):
+    enc = Encoder()
+    enc.register_pods(pods)
+    table = encode_nodes(enc, nodes)
+    batch = encode_pods(enc, pods)
+    ns = node_static_from_table(enc, table)
+    carry = carry_from_table(table, initial_selector_counts(enc, table, []))
+    rows = pod_rows_from_batch(batch)
+    fc, placed, reasons, _ = schedule_batch(ns, carry, rows, weights_array())
+    names = [table.names[i] if i >= 0 else None for i in np.asarray(placed)[: len(pods)]]
+    return names, np.asarray(reasons), fc, table
+
+
+# ---------------------------------------------------------------------------
+# annotation codecs
+# ---------------------------------------------------------------------------
+
+def test_node_storage_codec():
+    node = storage_node(
+        "n",
+        vgs=[("pool0", 100 * GiB, 5 * GiB)],
+        devices=[("/dev/vdd", 50 * GiB, "hdd", "false")],
+    )
+    st = node.local_storage()
+    assert st is not None
+    assert st.vgs[0].name == "pool0"
+    assert st.vgs[0].capacity == 100 * GiB
+    assert st.vgs[0].requested == 5 * GiB
+    assert st.devices[0].name == "/dev/vdd"
+    assert st.devices[0].media_type == "hdd"
+    assert not st.devices[0].is_allocated
+    assert Node.from_dict({"metadata": {"name": "x"}}).local_storage() is None
+
+
+def test_pod_volume_split():
+    pod = storage_pod(
+        "p",
+        [
+            lvm_vol(5 * GiB),
+            dev_vol(10 * GiB, "ssd"),
+            dev_vol(20 * GiB, "hdd"),
+            {"size": "1", "kind": "Bogus", "scName": "open-local-lvm"},
+        ],
+    )
+    lvm, dev = pod.local_volumes()
+    assert [v.size for v in lvm] == [5 * GiB]
+    assert sorted(v.size for v in dev) == [10 * GiB, 20 * GiB]
+    assert {v.media_type for v in dev} == {"ssd", "hdd"}
+
+
+# ---------------------------------------------------------------------------
+# LVM binpack semantics
+# ---------------------------------------------------------------------------
+
+def test_lvm_binpack_prefers_smallest_fitting_vg():
+    nodes = [
+        storage_node("big", vgs=[("pool0", 100 * GiB, 0)]),
+        storage_node("small", vgs=[("pool0", 10 * GiB, 0)]),
+    ]
+    names, _, _, _ = run_batch(nodes, [storage_pod("p", [lvm_vol(5 * GiB)])])
+    # ScoreLVM(Binpack) rewards the higher used/capacity fraction -> "small"
+    assert names == ["small"]
+
+
+def test_lvm_binpack_across_vgs_on_one_node():
+    # Two VGs: request fits only the bigger one once the smaller fills up.
+    nodes = [
+        storage_node("n", vgs=[("pool0", 8 * GiB, 0), ("pool1", 40 * GiB, 0)])
+    ]
+    pods = [
+        storage_pod("a", [lvm_vol(6 * GiB)]),   # -> pool0 (smallest fit)
+        storage_pod("b", [lvm_vol(6 * GiB)]),   # pool0 has 2GiB left -> pool1
+        storage_pod("c", [lvm_vol(40 * GiB)]),  # pool1 has 34GiB left -> fail
+    ]
+    names, reasons, fc, _ = run_batch(nodes, pods)
+    assert names[:2] == ["n", "n"]
+    assert names[2] is None
+    assert reasons[2][F_STORAGE] == 1
+    vg_free = np.asarray(fc.vg_free)[0]
+    assert vg_free[0] == pytest.approx(2 * 1024, abs=1)      # pool0: 2GiB left
+    assert vg_free[1] == pytest.approx(34 * 1024, abs=1)     # pool1: 34GiB left
+
+
+def test_lvm_explicit_vg_name():
+    nodes = [
+        storage_node("n", vgs=[("alpha", 50 * GiB, 0), ("beta", 50 * GiB, 0)])
+    ]
+    names, _, fc, _ = run_batch(
+        nodes, [storage_pod("p", [lvm_vol(10 * GiB, vg="beta")])]
+    )
+    assert names == ["n"]
+    vg_free = np.asarray(fc.vg_free)[0]
+    assert vg_free[0] == pytest.approx(50 * 1024, abs=1)   # alpha untouched
+    assert vg_free[1] == pytest.approx(40 * 1024, abs=1)   # beta charged
+
+
+def test_lvm_explicit_vg_allocated_before_binpack():
+    # Reference order: pvcsWithVG first (common.go:59-75). A binpack volume
+    # listed earlier in the annotation must NOT steal the explicit volume's VG.
+    nodes = [
+        storage_node("n", vgs=[("vg1", 100 * GiB, 0), ("vg2", 120 * GiB, 0)])
+    ]
+    pods = [
+        storage_pod("p", [lvm_vol(90 * GiB), lvm_vol(90 * GiB, vg="vg1")])
+    ]
+    names, _, fc, _ = run_batch(nodes, pods)
+    assert names == ["n"]
+    vg_free = np.asarray(fc.vg_free)[0]
+    assert vg_free[0] == pytest.approx(10 * 1024, abs=1)   # vg1: explicit
+    assert vg_free[1] == pytest.approx(30 * 1024, abs=1)   # vg2: binpack
+
+
+def test_lvm_missing_vg_fails():
+    nodes = [storage_node("n", vgs=[("alpha", 50 * GiB, 0)])]
+    names, reasons, _, _ = run_batch(
+        nodes, [storage_pod("p", [lvm_vol(1 * GiB, vg="nope")])]
+    )
+    assert names == [None]
+    assert reasons[0][F_STORAGE] == 1
+
+
+def test_initial_requested_is_respected():
+    # 10GiB VG with 8GiB already requested can't take 5GiB.
+    nodes = [storage_node("n", vgs=[("pool0", 10 * GiB, 8 * GiB)])]
+    names, _, _, _ = run_batch(nodes, [storage_pod("p", [lvm_vol(5 * GiB)])])
+    assert names == [None]
+
+
+def test_no_storage_node_rejects_storage_pod():
+    nodes = [storage_node("plain")]  # no annotation
+    names, reasons, _, _ = run_batch(
+        nodes, [storage_pod("p", [lvm_vol(1 * GiB)])]
+    )
+    assert names == [None]
+    assert reasons[0][F_STORAGE] == 1
+
+
+def test_storage_free_pod_ignores_storage():
+    nodes = [storage_node("plain")]
+    pod = Pod.from_dict(
+        {
+            "metadata": {"name": "p", "namespace": "stor"},
+            "spec": {
+                "containers": [
+                    {"name": "c", "resources": {"requests": {"cpu": "1"}}}
+                ]
+            },
+        }
+    )
+    names, _, _, _ = run_batch(nodes, [pod])
+    assert names == ["plain"]
+
+
+# ---------------------------------------------------------------------------
+# exclusive devices
+# ---------------------------------------------------------------------------
+
+def test_device_exclusive_allocation():
+    nodes = [
+        storage_node(
+            "n",
+            devices=[("/dev/vdd", 100 * GiB, "ssd", "false")],
+        )
+    ]
+    pods = [
+        storage_pod("a", [dev_vol(10 * GiB, "ssd")]),
+        storage_pod("b", [dev_vol(10 * GiB, "ssd")]),  # device taken -> fail
+    ]
+    names, reasons, fc, _ = run_batch(nodes, pods)
+    assert names == ["n", None]
+    assert reasons[1][F_STORAGE] == 1
+    assert np.asarray(fc.dev_free)[0, 0] == 0.0
+
+
+def test_device_media_type_must_match():
+    nodes = [
+        storage_node("n", devices=[("/dev/vdd", 100 * GiB, "hdd", "false")])
+    ]
+    names, _, _, _ = run_batch(nodes, [storage_pod("p", [dev_vol(GiB, "ssd")])])
+    assert names == [None]
+
+
+def test_device_tightest_fit():
+    # Smallest device with enough capacity wins (ascending walk parity).
+    nodes = [
+        storage_node(
+            "n",
+            devices=[
+                ("/dev/big", 100 * GiB, "ssd", "false"),
+                ("/dev/small", 20 * GiB, "ssd", "false"),
+            ],
+        )
+    ]
+    names, _, fc, _ = run_batch(
+        nodes, [storage_pod("p", [dev_vol(10 * GiB, "ssd")])]
+    )
+    assert names == ["n"]
+    dev_free = np.asarray(fc.dev_free)[0]
+    assert dev_free[0] == 1.0   # big stays free
+    assert dev_free[1] == 0.0   # small allocated
+
+
+def test_device_pre_allocated_is_skipped():
+    nodes = [
+        storage_node("n", devices=[("/dev/vdd", 100 * GiB, "ssd", "true")])
+    ]
+    names, _, _, _ = run_batch(
+        nodes, [storage_pod("p", [dev_vol(GiB, "ssd")])]
+    )
+    assert names == [None]
+
+
+def test_multi_volume_pod():
+    nodes = [
+        storage_node(
+            "n",
+            vgs=[("pool0", 50 * GiB, 0)],
+            devices=[
+                ("/dev/vdd", 30 * GiB, "ssd", "false"),
+                ("/dev/vde", 30 * GiB, "hdd", "false"),
+            ],
+        )
+    ]
+    pods = [
+        storage_pod(
+            "p",
+            [lvm_vol(10 * GiB), dev_vol(5 * GiB, "ssd"), dev_vol(5 * GiB, "hdd")],
+        )
+    ]
+    names, _, fc, _ = run_batch(nodes, pods)
+    assert names == ["n"]
+    assert np.asarray(fc.vg_free)[0, 0] == pytest.approx(40 * 1024, abs=1)
+    assert np.asarray(fc.dev_free)[0].tolist() == [0.0, 0.0]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end via simulate() with STS volumeClaimTemplates
+# ---------------------------------------------------------------------------
+
+def test_statefulset_volume_claims_end_to_end():
+    sts = {
+        "apiVersion": "apps/v1",
+        "kind": "StatefulSet",
+        "metadata": {"name": "db", "namespace": "stor"},
+        "spec": {
+            "replicas": 2,
+            "template": {
+                "metadata": {"labels": {"app": "db"}},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "c",
+                            "resources": {
+                                "requests": {"cpu": "100m", "memory": "128Mi"}
+                            },
+                        }
+                    ]
+                },
+            },
+            "volumeClaimTemplates": [
+                {
+                    "metadata": {"name": "data"},
+                    "spec": {
+                        "storageClassName": "open-local-lvm",
+                        "resources": {"requests": {"storage": "8Gi"}},
+                    },
+                }
+            ],
+        },
+    }
+    cluster = ClusterResource(
+        nodes=[
+            storage_node("w1", vgs=[("pool0", 10 * GiB, 0)]),
+            storage_node("w2", vgs=[("pool0", 10 * GiB, 0)]),
+        ]
+    )
+    result = simulate(cluster, [AppResource(name="db", objects=[sts])])
+    # each 8GiB claim fills most of one 10GiB VG; two replicas need two nodes
+    assert not result.unscheduled
+    placed_nodes = {
+        st.node.name for st in result.node_status if st.pods
+    }
+    assert placed_nodes == {"w1", "w2"}
+    # result.storage reflects the committed requests
+    for name in ("w1", "w2"):
+        vg = result.storage[name].vgs[0]
+        assert vg.requested == pytest.approx(8 * GiB, rel=1e-6)
+
+
+def test_capacity_exhaustion_reports_storage_reason():
+    sts_vol = [lvm_vol(8 * GiB)]
+    cluster = ClusterResource(nodes=[storage_node("w1", vgs=[("pool0", 10 * GiB, 0)])])
+    pods = [storage_pod("a", sts_vol), storage_pod("b", sts_vol)]
+    cluster.pods.extend(pods)
+    result = simulate(cluster, [])
+    assert len(result.unscheduled) == 1
+    assert "local storage" in result.unscheduled[0].reason
