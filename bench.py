@@ -167,7 +167,7 @@ def main() -> int:
     compile_s = time.time() - t0
 
     t1 = time.time()
-    _, placed, _, _ = schedule_batch_grouped(ns, carry, batch, w)
+    _, placed, *_ = schedule_batch_grouped(ns, carry, batch, w)
     run = time.time() - t1
     scheduled = int((placed >= 0).sum())
     pods_per_sec = args.pods / run

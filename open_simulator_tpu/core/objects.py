@@ -463,6 +463,7 @@ class Pod:
     spread_constraints: List[TopologySpreadConstraint] = field(default_factory=list)
     scheduler_name: str = DEFAULT_SCHEDULER
     priority: int = 0
+    preemption_policy: str = "PreemptLowerPriority"
     phase: str = "Pending"
     host_ports: List[Tuple[str, int]] = field(default_factory=list)  # (protocol, port)
     pvc_names: List[str] = field(default_factory=list)
@@ -503,6 +504,7 @@ class Pod:
             ],
             scheduler_name=spec.get("schedulerName") or DEFAULT_SCHEDULER,
             priority=int(spec.get("priority") or 0),
+            preemption_policy=spec.get("preemptionPolicy") or "PreemptLowerPriority",
             phase=status.get("phase", "Pending"),
             host_ports=host_ports,
             pvc_names=pvcs,

@@ -169,6 +169,18 @@ def gpu_report(result: SimulateResult) -> str:
     return render_table(headers, rows)
 
 
+def preempted_report(result: SimulateResult) -> str:
+    """Victims evicted by DefaultPreemption (the reference emits 'Preempted'
+    events via the event recorder; here they surface as a table)."""
+    if not result.preempted:
+        return ""
+    headers = ["Victim", "Node", "Preempted By", "Priority"]
+    rows = [
+        [p.pod.key, p.node, p.by, p.pod.priority] for p in result.preempted
+    ]
+    return render_table(headers, rows)
+
+
 def unscheduled_report(result: SimulateResult) -> str:
     if not result.unscheduled:
         return "All pods scheduled."
@@ -191,5 +203,8 @@ def full_report(result: SimulateResult, extended: bool = True) -> str:
         gpu = gpu_report(result)
         if gpu:
             parts += ["=== GPU Share ===", gpu]
+    pre = preempted_report(result)
+    if pre:
+        parts += ["=== Preempted ===", pre]
     parts += ["=== Unscheduled ===", unscheduled_report(result)]
     return "\n\n".join(parts)

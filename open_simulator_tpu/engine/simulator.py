@@ -103,6 +103,16 @@ class UnscheduledPod:
 
 
 @dataclass
+class PreemptedPod:
+    """A victim evicted by DefaultPreemption (vendored default_preemption.go
+    PrepareCandidate deletes victims from the cluster; the simulation records
+    them here instead of silently dropping them)."""
+    pod: Pod
+    node: str
+    by: str  # preemptor pod key
+
+
+@dataclass
 class NodeStatus:
     node: Node
     pods: List[Pod] = field(default_factory=list)
@@ -112,6 +122,7 @@ class NodeStatus:
 class SimulateResult:
     unscheduled: List[UnscheduledPod] = field(default_factory=list)
     node_status: List[NodeStatus] = field(default_factory=list)
+    preempted: List[PreemptedPod] = field(default_factory=list)
     # Post-simulation open-local state per node (the reference mutates the
     # node annotation on every storage Bind; here the device carry holds the
     # truth and is decoded once at the end): node name -> NodeLocalStorage
@@ -160,7 +171,10 @@ class Simulator:
         self._pending_cluster: List[Pod] = []
         for pod in cluster.pods:
             if pod.node_name:
-                self._bound.append((pod, pod.node_name))
+                # Copy: preemption may evict pre-bound pods (clearing
+                # node_name/phase/annotations), and the caller's cluster must
+                # stay pristine for re-simulation by the capacity search.
+                self._bound.append((copy.deepcopy(pod), pod.node_name))
             elif pod.scheduler_name == DEFAULT_SCHEDULER:
                 # Copy: scheduling mutates node_name/phase, and the caller's
                 # cluster must stay pristine for re-simulation (the capacity
@@ -172,6 +186,17 @@ class Simulator:
         self._table = None
         self._ns = None
         self._carry = None
+        self._storage_takes: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        self._preempted: List[PreemptedPod] = []
+        # PDBs ride along for DefaultPreemption's victim classification
+        # (parity: the reference syncs PDBs into the fake cluster,
+        # simulator.go:388-394, and the preemption plugin lists them).
+        from .preemption import PodDisruptionBudget
+
+        self._pdbs = [
+            PodDisruptionBudget.from_dict(d)
+            for d in cluster.others.get("PodDisruptionBudget", [])
+        ]
 
     # -- device state ------------------------------------------------------
     def _build_device_state(self, all_pods: Sequence[Pod]) -> None:
@@ -199,9 +224,14 @@ class Simulator:
         self._carry = align_sel_counts(self._carry, len(self.enc.selectors))
         # Grouped path: identical results to the naive scan, but static
         # filter/score work is hoisted per run of identical pods.
-        self._carry, placed_np, reasons_np, take_np = schedule_batch_grouped(
-            self._ns, self._carry, batch, self.weights
-        )
+        (
+            self._carry,
+            placed_np,
+            reasons_np,
+            take_np,
+            vg_np,
+            dev_np,
+        ) = schedule_batch_grouped(self._ns, self._carry, batch, self.weights)
         failed: List[UnscheduledPod] = []
         n_nodes = len(self.cluster.nodes)
         for i, pod in enumerate(pods):
@@ -220,12 +250,119 @@ class Simulator:
                     ]
                     if ids:
                         pod.meta.annotations[ANNO_GPU_INDEX] = "-".join(ids)
+                if vg_np[i].any() or dev_np[i].any():
+                    # Remember which VG slots / devices this pod took so an
+                    # eviction can reverse the allocation exactly.
+                    self._storage_takes[pod.key] = (
+                        vg_np[i].copy(),
+                        dev_np[i].copy(),
+                    )
                 self._bound.append((pod, pod.node_name))
             else:
                 failed.append(
                     UnscheduledPod(pod, _reason_string(n_nodes, reasons_np[i]))
                 )
         return failed
+
+    # -- preemption (PostFilter) -------------------------------------------
+    def _try_preemptions(
+        self, failed: List[UnscheduledPod]
+    ) -> List[UnscheduledPod]:
+        """DefaultPreemption pass over this batch's failures: pods with
+        priority > 0 may evict lower-priority pods (engine/preemption.py).
+        Successful preemptors are rescheduled immediately; victims are
+        removed from the cluster (the reference deletes them,
+        default_preemption.go PrepareCandidate)."""
+        from .preemption import try_preempt
+
+        still_failed: List[UnscheduledPod] = []
+        bound_by_node: Optional[Dict[str, List[Pod]]] = None
+        for u in failed:
+            pod = u.pod
+            if pod.priority <= 0:
+                still_failed.append(u)
+                continue
+            if bound_by_node is None:
+                bound_by_node = {}
+                for p, node_name in self._bound:
+                    bound_by_node.setdefault(node_name, []).append(p)
+            res = try_preempt(pod, self.cluster.nodes, bound_by_node, self._pdbs)
+            if res is None or not res.victims:
+                still_failed.append(u)
+                continue
+            # The host-side victim model covers resources only; the device
+            # retry additionally enforces spread/affinity/storage/GPU. Snapshot
+            # everything eviction touches so a failed retry rolls back instead
+            # of leaving pods evicted for nothing.
+            snapshot = (
+                self._carry,
+                list(self._bound),
+                dict(self._storage_takes),
+                len(self._preempted),
+                [
+                    (v, v.node_name, v.phase, v.meta.annotations.get(ANNO_GPU_INDEX))
+                    for v in res.victims
+                ],
+            )
+            self._evict(res.victims, res.node, by=pod.key)
+            # Reschedule the preemptor now that room exists. The reference
+            # nominates the node and requeues; the retried pod normally lands
+            # there but isn't pinned — same here (scores decide).
+            retry_failed = self._schedule_batch_host([pod])
+            if retry_failed:
+                carry, bound_list, takes, n_pre, fields = snapshot
+                self._carry = carry
+                self._bound = bound_list
+                self._storage_takes = takes
+                del self._preempted[n_pre:]
+                for v, node_name, phase, gpu_anno in fields:
+                    v.node_name, v.phase = node_name, phase
+                    if gpu_anno is not None:
+                        v.meta.annotations[ANNO_GPU_INDEX] = gpu_anno
+                still_failed.extend(retry_failed)
+            else:
+                bound_by_node = None  # placements changed; rebuild lazily
+        return still_failed
+
+    def _evict(self, victims: List[Pod], node_name: str, by: str) -> None:
+        """Remove victims from a node and reverse their carry contributions."""
+        victim_keys = {id(v) for v in victims}
+        self._bound = [
+            (p, n) for p, n in self._bound if id(p) not in victim_keys
+        ]
+        ni = self._table.names.index(node_name)
+        free = np.asarray(self._carry.free).copy()
+        sel = np.asarray(self._carry.sel_counts).copy()
+        gpu = np.asarray(self._carry.gpu_free).copy()
+        vg = np.asarray(self._carry.vg_free).copy()
+        dev = np.asarray(self._carry.dev_free).copy()
+        from ..ops.encode import resource_scale
+
+        for v in victims:
+            for res, q in v.requests.items():
+                r = self.enc.resources.index(res) if res in self.enc.resources else -1
+                if r >= 0:
+                    free[ni, r] += q / resource_scale(res)
+            free[ni, self.enc.resources.index("pods")] += 1.0
+            for s, entry in enumerate(self.enc.selectors):
+                if s < sel.shape[0] and entry.matches(v):
+                    sel[s, ni] -= 1.0
+            mem = v.gpu_mem_request()
+            if mem > 0:
+                for d in v.gpu_index_ids():
+                    if 0 <= d < gpu.shape[1]:
+                        gpu[ni, d] += np.float32(mem / float(1 << 20))
+            takes = self._storage_takes.pop(v.key, None)
+            if takes is not None:
+                vg[ni, : takes[0].shape[0]] += takes[0]
+                dev[ni, : takes[1].shape[0]] += takes[1]
+            v.node_name = ""
+            v.phase = "Pending"
+            v.meta.annotations.pop(ANNO_GPU_INDEX, None)
+            self._preempted.append(PreemptedPod(pod=v, node=node_name, by=by))
+        self._carry = self._carry._replace(
+            free=free, sel_counts=sel, gpu_free=gpu, vg_free=vg, dev_free=dev
+        )
 
     # -- public ------------------------------------------------------------
     def run(self, apps: Sequence[AppResource]) -> SimulateResult:
@@ -245,11 +382,15 @@ class Simulator:
         result = SimulateResult()
         # RunCluster: the cluster's own pending pods schedule first.
         result.unscheduled.extend(
-            self._schedule_batch_host(_order_pods(self._pending_cluster))
+            self._try_preemptions(
+                self._schedule_batch_host(_order_pods(self._pending_cluster))
+            )
         )
         # ScheduleApp: each app in configured order.
         for pods in app_pods:
-            result.unscheduled.extend(self._schedule_batch_host(pods))
+            result.unscheduled.extend(
+                self._try_preemptions(self._schedule_batch_host(pods))
+            )
 
         by_node: Dict[str, NodeStatus] = {
             n.name: NodeStatus(node=n) for n in self.cluster.nodes
@@ -259,6 +400,7 @@ class Simulator:
                 by_node[node_name].pods.append(pod)
         result.node_status = list(by_node.values())
         result.storage = self._storage_status()
+        result.preempted = list(self._preempted)
         return result
 
     def _storage_status(self) -> Dict[str, NodeLocalStorage]:

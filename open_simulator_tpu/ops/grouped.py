@@ -48,6 +48,7 @@ from .kernels import (
     _minmax_normalize,
     gpu_allocate,
     gpu_mask,
+    local_storage_commit,
     local_storage_eval,
     node_affinity_mask,
     pod_affinity_mask,
@@ -117,7 +118,9 @@ def schedule_group(
         res_fail = resource_fail(ns, c, pod)
         spread_ok = spread_mask(ns, c, pod)
         aff_ok = pod_affinity_mask(ns, c, pod)
-        storage_ok, vg_take, dev_take, storage_raw = local_storage_eval(ns, c, pod)
+        # takes are re-derived inside local_storage_commit below; XLA CSE
+        # collapses the two local_storage_eval calls within one jit
+        storage_ok, _, _, storage_raw = local_storage_eval(ns, c, pod)
         gpu_ok = gpu_mask(ns, c, pod)
         mask = (
             static_ok & ~res_fail & spread_ok & aff_ok & storage_ok & gpu_ok
@@ -151,9 +154,9 @@ def schedule_group(
             * onehot.astype(jnp.float32)[None, :]
         )
         gpu_take, gpu_free = gpu_allocate(ns, c, pod, onehot)
-        sel_f = onehot.astype(jnp.float32)[:, None]
-        vg_free = c.vg_free - sel_f * vg_take
-        dev_free = c.dev_free - sel_f * dev_take
+        vg_free, dev_free, vg_take_sel, dev_take_sel = local_storage_commit(
+            ns, c, pod, onehot
+        )
 
         first_fail = jnp.where(
             static_ff < NUM_FILTERS,
@@ -188,6 +191,8 @@ def schedule_group(
             node_out.astype(jnp.int32),
             reason_counts,
             gpu_take.astype(jnp.int32),
+            vg_take_sel,
+            dev_take_sel,
         )
 
     return jax.lax.scan(step, carry, jnp.arange(group_size))
@@ -244,14 +249,19 @@ def schedule_batch_grouped(
     """schedule_batch semantics via per-group inner scans.
 
     Returns (carry, nodes i32[batch.p], reasons i32[batch.p, F],
-    gpu_take i32[batch.p, G]) — identical to the naive kernel's output for the
-    same batch.
+    gpu_take i32[batch.p, G], vg_take f32[batch.p, V], dev_take
+    f32[batch.p, DV]) — identical to the naive kernel's output for the same
+    batch.
     """
     P = batch.p
     G = ns.gpu_total.shape[1]
+    V = ns.vg_cap.shape[1]
+    DV = ns.dev_cap.shape[1]
     nodes_out = np.full(P, -1, np.int32)
     reasons_out = np.zeros((P, NUM_FILTERS), np.int32)
     take_out = np.zeros((P, G), np.int32)
+    vg_out = np.zeros((P, V), np.float32)
+    dev_out = np.zeros((P, DV), np.float32)
     rows_all = pod_rows_from_batch(batch)
 
     for start, length in group_runs(batch):
@@ -260,11 +270,14 @@ def schedule_batch_grouped(
         while done < length:
             n = min(length - done, max_group_chunk)
             g = _bucket(n)
-            carry, (nodes, reasons, take) = _group_jit(
+            carry, (nodes, reasons, take, vg_take, dev_take) = _group_jit(
                 ns, carry, row, g, jnp.int32(n), weights
             )
-            nodes_out[start + done : start + done + n] = np.asarray(nodes)[:n]
-            reasons_out[start + done : start + done + n] = np.asarray(reasons)[:n]
-            take_out[start + done : start + done + n] = np.asarray(take)[:n]
+            sl = slice(start + done, start + done + n)
+            nodes_out[sl] = np.asarray(nodes)[:n]
+            reasons_out[sl] = np.asarray(reasons)[:n]
+            take_out[sl] = np.asarray(take)[:n]
+            vg_out[sl] = np.asarray(vg_take)[:n]
+            dev_out[sl] = np.asarray(dev_take)[:n]
             done += n
-    return carry, nodes_out, reasons_out, take_out
+    return carry, nodes_out, reasons_out, take_out, vg_out, dev_out

@@ -536,12 +536,23 @@ def score_open_local(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
 
 def local_storage_commit(
     ns: NodeStatic, carry: Carry, pod: PodRow, node_onehot: jnp.ndarray
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Commit the chosen node's storage allocation (the Bind-side annotation
-    rewrite, open-local.go:221-247): VG requested += size, device allocated."""
-    _, vg_take, dev_take, _ = local_storage_eval(ns, carry, pod)
-    sel = node_onehot.astype(jnp.float32)[:, None]
-    return carry.vg_free - sel * vg_take, carry.dev_free - sel * dev_take
+    rewrite, open-local.go:221-247): VG requested += size, device allocated.
+
+    Returns (vg_free f32[N,V], dev_free f32[N,DV], vg_take f32[V], dev_take
+    f32[DV]) — the takes are the selected node's slice, recorded per pod so an
+    eviction can reverse the allocation exactly."""
+    _, vg_take_all, dev_take_all, _ = local_storage_eval(ns, carry, pod)
+    sel = node_onehot.astype(jnp.float32)
+    vg_take = jnp.einsum("n,nv->v", sel, vg_take_all)
+    dev_take = jnp.einsum("n,nd->d", sel, dev_take_all)
+    return (
+        carry.vg_free - sel[:, None] * vg_take_all,
+        carry.dev_free - sel[:, None] * dev_take_all,
+        vg_take,
+        dev_take,
+    )
 
 
 def resource_fail(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
@@ -775,7 +786,9 @@ def schedule_step(ns: NodeStatic, weights: jnp.ndarray, carry: Carry, pod: PodRo
         pod.match_sel.astype(jnp.float32)[:, None] * onehot.astype(jnp.float32)[None, :]
     )
     gpu_take, gpu_free = gpu_allocate(ns, carry, pod, onehot)
-    vg_free, dev_free = local_storage_commit(ns, carry, pod, onehot)
+    vg_free, dev_free, vg_take, dev_take = local_storage_commit(
+        ns, carry, pod, onehot
+    )
 
     reason_counts = jnp.zeros(NUM_FILTERS, jnp.int32).at[
         jnp.clip(first_fail, 0, NUM_FILTERS - 1)
@@ -790,6 +803,8 @@ def schedule_step(ns: NodeStatic, weights: jnp.ndarray, carry: Carry, pod: PodRo
         node_out.astype(jnp.int32),
         reason_counts,
         gpu_take.astype(jnp.int32),
+        vg_take,
+        dev_take,
     )
 
 
@@ -798,11 +813,15 @@ def schedule_batch(ns: NodeStatic, carry: Carry, pods: PodRow, weights: jnp.ndar
     """Schedule a whole PodBatch sequentially on device.
 
     Returns (final_carry, nodes i32[P] (-1 = unschedulable), reasons i32[P,F],
-    gpu_take i32[P,G] — shares allocated per device on the chosen node).
+    gpu_take i32[P,G] — shares allocated per device on the chosen node,
+    vg_take f32[P,V] — MiB claimed per VG slot of the chosen node,
+    dev_take f32[P,DV] — devices claimed on the chosen node).
     """
 
     def step(c, pod):
         return schedule_step(ns, weights, c, pod)
 
-    final_carry, (nodes, reasons, gpu_take) = jax.lax.scan(step, carry, pods)
-    return final_carry, nodes, reasons, gpu_take
+    final_carry, (nodes, reasons, gpu_take, vg_take, dev_take) = jax.lax.scan(
+        step, carry, pods
+    )
+    return final_carry, nodes, reasons, gpu_take, vg_take, dev_take

@@ -95,8 +95,10 @@ def sharded_schedule_batch(mesh: Mesh):
         def step(c, pod):
             return schedule_step(ns, weights, c, pod)
 
-        final_carry, (nodes, reasons, gpu_take) = jax.lax.scan(step, carry, pods)
-        return final_carry, nodes, reasons, gpu_take
+        final_carry, (nodes, reasons, gpu_take, vg_take, dev_take) = jax.lax.scan(
+            step, carry, pods
+        )
+        return final_carry, nodes, reasons, gpu_take, vg_take, dev_take
 
     rep = NamedSharding(mesh, P())
     return jax.jit(
@@ -107,5 +109,5 @@ def sharded_schedule_batch(mesh: Mesh):
             None,     # pods: let XLA replicate
             rep,      # weights
         ),
-        out_shardings=(carry_sharding(mesh), rep, rep, rep),
+        out_shardings=(carry_sharding(mesh), rep, rep, rep, rep, rep),
     )

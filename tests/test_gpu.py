@@ -97,7 +97,7 @@ def run_gpu(nodes, pods, placed=()):
     ns = node_static_from_table(enc, table)
     carry = carry_from_table(table, initial_selector_counts(enc, table, list(placed)))
     rows = pod_rows_from_batch(batch)
-    final, placed_idx, reasons, take = schedule_batch(ns, carry, rows, weights_array())
+    final, placed_idx, reasons, take, *_ = schedule_batch(ns, carry, rows, weights_array())
     names = [
         table.names[int(i)] if int(i) >= 0 else None
         for i in np.asarray(placed_idx)[: len(pods)]

@@ -43,7 +43,7 @@ def test_grouped_matches_naive_on_synthetic_mix():
     # every pod, so runs are short — a worst case for grouping, best for parity.
     ns, carry, rows = _state(32, 48)
     w = weights_array()
-    _, nodes_ref, reasons_ref, _ = schedule_batch(ns, carry, rows, w)
+    _, nodes_ref, reasons_ref, *_ = schedule_batch(ns, carry, rows, w)
 
     # rebuild the PodBatch (numpy) for the grouped API
     import jax
@@ -66,7 +66,7 @@ def test_grouped_matches_naive_on_synthetic_mix():
 
     # For this test, wrap rows back into numpy arrays with batch semantics:
     batch = _rows_to_batch(rows)
-    carry2, nodes_grp, reasons_grp, _ = schedule_batch_grouped(ns, carry, batch, w)
+    carry2, nodes_grp, reasons_grp, *_ = schedule_batch_grouped(ns, carry, batch, w)
     total = int(batch.valid.sum())  # padding rows: naive computes throwaway
     np.testing.assert_array_equal(np.asarray(nodes_ref)[:total], nodes_grp[:total])
     np.testing.assert_array_equal(np.asarray(reasons_ref)[:total], reasons_grp[:total])
@@ -86,8 +86,8 @@ def test_grouped_matches_naive_on_tiled_templates():
     ns, carry, batch = build_state(64, 256)
     w = weights_array()
     rows = pod_rows_from_batch(batch)
-    _, nodes_ref, reasons_ref, _ = schedule_batch(ns, carry, rows, w)
-    _, nodes_grp, reasons_grp, _ = schedule_batch_grouped(ns, carry, batch, w)
+    _, nodes_ref, reasons_ref, *_ = schedule_batch(ns, carry, rows, w)
+    _, nodes_grp, reasons_grp, *_ = schedule_batch_grouped(ns, carry, batch, w)
     total = int(batch.valid.sum())
     np.testing.assert_array_equal(np.asarray(nodes_ref)[:total], nodes_grp[:total])
     np.testing.assert_array_equal(np.asarray(reasons_ref)[:total], reasons_grp[:total])

@@ -68,7 +68,7 @@ def encode_all(nodes, pods, placed=()):
 
 def run(nodes, pods, placed=()):
     enc, table, batch, ns, carry, rows = encode_all(nodes, pods, placed)
-    carry2, placed_idx, reasons, _ = schedule_batch(ns, carry, rows, weights_array())
+    carry2, placed_idx, reasons, *_ = schedule_batch(ns, carry, rows, weights_array())
     names = [table.names[i] if i >= 0 else None for i in np.asarray(placed_idx)[: len(pods)]]
     return names, np.asarray(reasons), np.asarray(carry2.free), table
 
@@ -402,6 +402,6 @@ def test_existing_pods_consume_free():
     ns = node_static_from_table(enc, table)
     carry = carry_from_table(table, initial_selector_counts(enc, table, [(existing, "a")]))
     rows = pod_rows_from_batch(batch)
-    _, placed, reasons, _ = schedule_batch(ns, carry, rows, weights_array())
+    _, placed, reasons, *_ = schedule_batch(ns, carry, rows, weights_array())
     assert np.asarray(placed)[0] == -1  # only 1 cpu free, pod wants 2
     assert np.asarray(reasons)[0][F_RESOURCES] == 1

@@ -27,12 +27,12 @@ def test_eight_device_mesh_available():
 def test_sharded_matches_single_device():
     ns, carry, rows = synthetic(64, 96)
     w = weights_array()
-    carry_ref, nodes_ref, reasons_ref, _ = schedule_batch(ns, carry, rows, w)
+    carry_ref, nodes_ref, reasons_ref, *_ = schedule_batch(ns, carry, rows, w)
 
     mesh = make_mesh()
     ns_sh, carry_sh = shard_state(mesh, ns, carry)
     fn = sharded_schedule_batch(mesh)
-    carry_out, nodes_sh, reasons_sh, _ = fn(ns_sh, carry_sh, rows, w)
+    carry_out, nodes_sh, reasons_sh, *_ = fn(ns_sh, carry_sh, rows, w)
 
     np.testing.assert_array_equal(np.asarray(nodes_ref), np.asarray(nodes_sh))
     np.testing.assert_array_equal(np.asarray(reasons_ref), np.asarray(reasons_sh))

@@ -1,0 +1,192 @@
+"""DefaultPreemption: victim selection, PDB classification, node picking.
+
+Parity target: vendor/k8s.io/kubernetes/pkg/scheduler/framework/plugins/
+defaultpreemption/default_preemption.go (selectVictimsOnNode :578,
+filterPodsWithPDBViolation :736, pickOneNodeForPreemption :443,
+PodEligibleToPreemptOthers :231).
+"""
+
+import numpy as np
+
+from open_simulator_tpu.core.objects import Node, Pod
+from open_simulator_tpu.engine.preemption import (
+    PodDisruptionBudget,
+    PreemptionResult,
+    pick_one_node,
+    select_victims_on_node,
+    try_preempt,
+)
+from open_simulator_tpu.engine.simulator import (
+    AppResource,
+    ClusterResource,
+    simulate,
+)
+
+
+def mknode(name, cpu="4", mem="8Gi", taints=None):
+    return Node.from_dict(
+        {
+            "metadata": {"name": name},
+            "spec": {"taints": taints or []},
+            "status": {
+                "allocatable": {"cpu": cpu, "memory": mem, "pods": "110"}
+            },
+        }
+    )
+
+
+def mkpod(name, cpu="1", priority=0, labels=None, ns="default", node="", policy=None):
+    spec = {
+        "containers": [
+            {"name": "c", "resources": {"requests": {"cpu": cpu}}}
+        ],
+        "priority": priority,
+    }
+    if node:
+        spec["nodeName"] = node
+    if policy:
+        spec["preemptionPolicy"] = policy
+    return Pod.from_dict(
+        {
+            "metadata": {"name": name, "namespace": ns, "labels": labels or {}},
+            "spec": spec,
+        }
+    )
+
+
+def bound(node_name, *pods):
+    for p in pods:
+        p.node_name = node_name
+        p.phase = "Running"
+    return list(pods)
+
+
+# ---------------------------------------------------------------------------
+# selectVictimsOnNode
+# ---------------------------------------------------------------------------
+
+def test_minimal_victim_set_via_reprieve():
+    node = mknode("n", cpu="4")
+    low1 = mkpod("low1", cpu="2", priority=1)
+    low2 = mkpod("low2", cpu="2", priority=2)
+    preemptor = mkpod("hi", cpu="2", priority=100)
+    res = select_victims_on_node(
+        preemptor, node, bound("n", low1, low2), [], {}
+    )
+    # Removing just one 2-cpu victim suffices; the higher-priority low2 is
+    # reprieved first, so low1 is the victim.
+    assert res is not None
+    assert [v.meta.name for v in res.victims] == ["low1"]
+    assert res.num_pdb_violations == 0
+
+
+def test_no_preemption_when_insufficient_even_after_evictions():
+    node = mknode("n", cpu="4")
+    low = mkpod("low", cpu="1", priority=1)
+    preemptor = mkpod("hi", cpu="8", priority=100)  # never fits
+    assert select_victims_on_node(preemptor, node, bound("n", low), [], {}) is None
+
+
+def test_equal_priority_pods_are_not_victims():
+    node = mknode("n", cpu="2")
+    peer = mkpod("peer", cpu="2", priority=100)
+    preemptor = mkpod("hi", cpu="2", priority=100)
+    assert select_victims_on_node(preemptor, node, bound("n", peer), [], {}) is None
+
+
+def test_pdb_protected_pods_reprieved_first():
+    node = mknode("n", cpu="4")
+    protected = mkpod("protected", cpu="2", priority=1, labels={"app": "db"})
+    plain = mkpod("plain", cpu="2", priority=1)
+    pdb = PodDisruptionBudget(
+        name="db-pdb",
+        namespace="default",
+        selector=__import__(
+            "open_simulator_tpu.core.objects", fromlist=["LabelSelector"]
+        ).LabelSelector.from_dict({"matchLabels": {"app": "db"}}),
+        disruptions_allowed=0,
+    )
+    preemptor = mkpod("hi", cpu="2", priority=100)
+    res = select_victims_on_node(
+        preemptor, node, bound("n", protected, plain), [pdb], {0: 0}
+    )
+    # Evicting one pod suffices; the PDB-violating pod is reprieved first, so
+    # the plain pod is chosen and no budget is violated.
+    assert res is not None
+    assert [v.meta.name for v in res.victims] == ["plain"]
+    assert res.num_pdb_violations == 0
+
+
+# ---------------------------------------------------------------------------
+# pickOneNodeForPreemption tiebreaks
+# ---------------------------------------------------------------------------
+
+def test_pick_node_prefers_fewer_pdb_violations():
+    a = PreemptionResult("a", [mkpod("v", priority=5)], num_pdb_violations=1)
+    b = PreemptionResult("b", [mkpod("v", priority=50)], num_pdb_violations=0)
+    assert pick_one_node([a, b]).node == "b"
+
+
+def test_pick_node_prefers_lower_max_victim_priority():
+    a = PreemptionResult("a", [mkpod("v1", priority=50)], 0)
+    b = PreemptionResult("b", [mkpod("v2", priority=5)], 0)
+    assert pick_one_node([a, b]).node == "b"
+
+
+def test_pick_node_prefers_fewer_victims():
+    a = PreemptionResult("a", [mkpod("v1", priority=5), mkpod("v2", priority=5)], 0)
+    b = PreemptionResult("b", [mkpod("v3", priority=5), mkpod("v4", priority=5),], 0)
+    # equal so far: same max priority, compare sums -> a has 10, b has 10;
+    # same victim count -> first wins
+    assert pick_one_node([a, b]).node == "a"
+    c = PreemptionResult("c", [mkpod("v5", priority=10)], 0)
+    # c loses on max-victim-priority (10 > 5) despite fewer victims
+    assert pick_one_node([a, c]).node == "a"
+
+
+# ---------------------------------------------------------------------------
+# try_preempt + engine integration
+# ---------------------------------------------------------------------------
+
+def test_preemption_policy_never_blocks():
+    node = mknode("n", cpu="2")
+    low = mkpod("low", cpu="2", priority=1)
+    preemptor = mkpod("hi", cpu="2", priority=100, policy="Never")
+    assert try_preempt(preemptor, [node], {"n": bound("n", low)}, []) is None
+
+
+def test_tainted_node_is_unresolvable():
+    node = mknode(
+        "n", cpu="4",
+        taints=[{"key": "k", "value": "v", "effect": "NoSchedule"}],
+    )
+    low = mkpod("low", cpu="4", priority=1)
+    preemptor = mkpod("hi", cpu="2", priority=100)
+    assert try_preempt(preemptor, [node], {"n": bound("n", low)}, []) is None
+
+
+def test_end_to_end_preemption():
+    # One 4-cpu node filled by low-priority pods; a high-priority pod arrives.
+    cluster = ClusterResource(nodes=[mknode("w", cpu="4")])
+    low_pods = [mkpod(f"low{i}", cpu="2", priority=1) for i in range(2)]
+    cluster.pods.extend(low_pods)
+    hi = mkpod("hi", cpu="2", priority=1000)
+    app = AppResource(name="critical", objects=[hi.raw | {"kind": "Pod"}])
+    result = simulate(cluster, [app])
+    assert not result.unscheduled
+    assert len(result.preempted) == 1
+    assert result.preempted[0].by == "default/hi"
+    # the preemptor landed on the node
+    placed = {p.meta.name for st in result.node_status for p in st.pods}
+    assert "hi" in placed
+    assert result.preempted[0].pod.meta.name not in placed
+
+
+def test_end_to_end_no_preemption_for_priorityless_pod():
+    cluster = ClusterResource(nodes=[mknode("w", cpu="4")])
+    cluster.pods.extend([mkpod(f"low{i}", cpu="2", priority=1) for i in range(2)])
+    plain = mkpod("plain", cpu="2", priority=0)
+    app = AppResource(name="app", objects=[plain.raw | {"kind": "Pod"}])
+    result = simulate(cluster, [app])
+    assert len(result.unscheduled) == 1
+    assert not result.preempted

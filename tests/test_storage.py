@@ -120,7 +120,7 @@ def run_batch(nodes, pods):
     ns = node_static_from_table(enc, table)
     carry = carry_from_table(table, initial_selector_counts(enc, table, []))
     rows = pod_rows_from_batch(batch)
-    fc, placed, reasons, _ = schedule_batch(ns, carry, rows, weights_array())
+    fc, placed, reasons, *_ = schedule_batch(ns, carry, rows, weights_array())
     names = [table.names[i] if i >= 0 else None for i in np.asarray(placed)[: len(pods)]]
     return names, np.asarray(reasons), fc, table
 
