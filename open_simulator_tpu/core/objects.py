@@ -18,7 +18,7 @@ from dataclasses import dataclass, field
 import math
 from typing import Dict, List, Optional, Tuple
 
-from ..utils.quantity import parse_quantity
+from ..utils.quantity import parse_quad, parse_quantity
 
 # Canonical resource names (mirrors corev1.ResourceName constants).
 CPU = "cpu"
@@ -74,6 +74,14 @@ def _canon_resources(res: Optional[dict], round_up: bool) -> Dict[str, int]:
         return out
     rounder = math.ceil if round_up else math.floor
     for name, val in res.items():
+        if isinstance(val, str):
+            # cached/native fast path (utils.quantity.parse_quad)
+            mc, mf, bc, bf = parse_quad(val)
+            if name == CPU:
+                out[str(name)] = mc if round_up else mf
+            else:
+                out[str(name)] = bc if round_up else bf
+            continue
         q = parse_quantity(val)
         if name == CPU:
             q *= 1000

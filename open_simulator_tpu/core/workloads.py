@@ -49,6 +49,36 @@ WORKLOAD_KINDS = {DEPLOYMENT, REPLICASET, STATEFULSET, DAEMONSET, JOB, CRONJOB, 
 _rng = random.Random(0x51B0)
 
 
+def _clone_pod(proto: Pod, name: str) -> Pod:
+    """Cheap per-replica clone of a parsed template pod.
+
+    Replicas of one workload differ only in name: metadata (name + mutable
+    label/annotation/request dicts) is fresh per clone, while the spec-derived
+    immutable structures (affinity, tolerations, spread constraints, host
+    ports) are shared — the engine never mutates those. This replaces the
+    reference's per-replica template deep-copy (utils.go:139-150) and is what
+    makes 100k-pod expansion a data-loader, not a bottleneck."""
+    import dataclasses
+
+    raw = dict(proto.raw)
+    raw_meta = dict(raw.get("metadata") or {})
+    raw_meta["name"] = name
+    raw["metadata"] = raw_meta
+    meta = dataclasses.replace(
+        proto.meta,
+        name=name,
+        labels=dict(proto.meta.labels),
+        annotations=dict(proto.meta.annotations),
+    )
+    return dataclasses.replace(
+        proto,
+        meta=meta,
+        requests=dict(proto.requests),
+        limits=dict(proto.limits),
+        raw=raw,
+    )
+
+
 def reset_name_rng(seed: int = 0x51B0) -> None:
     _rng.seed(seed)
 
@@ -163,44 +193,80 @@ def pods_from_workload(obj: dict, nodes: Optional[List[Node]] = None) -> List[Po
         p = make_valid_pod_dict(obj)
         out.append(p)
     elif kind in (DEPLOYMENT, REPLICASET):
-        replicas = spec.get("replicas", 1)
-        template = spec.get("template") or {}
-        for _ in range(int(replicas if replicas is not None else 1)):
-            p = make_valid_pod_dict(_pod_dict_from_template(obj, REPLICASET, template))
-            # Deployment pods are annotated as ReplicaSet-owned (utils.go:132-135)
-            out.append(_add_workload_info(p, REPLICASET, name, namespace))
+        # Deployment pods are annotated as ReplicaSet-owned (utils.go:132-135)
+        return _expand_replicas(
+            obj, REPLICASET, spec.get("template") or {},
+            spec.get("replicas", 1), REPLICASET, name, namespace,
+            name_fn=None,
+        )
     elif kind == STATEFULSET:
-        replicas = spec.get("replicas", 1)
-        template = spec.get("template") or {}
         storage_ann = _storage_annotation(spec.get("volumeClaimTemplates") or [])
-        for ordinal in range(int(replicas if replicas is not None else 1)):
-            p = make_valid_pod_dict(_pod_dict_from_template(obj, STATEFULSET, template))
-            p["metadata"]["name"] = f"{name}-{ordinal}"
-            _add_workload_info(p, STATEFULSET, name, namespace)
-            if storage_ann:
-                p["metadata"]["annotations"][ANNO_POD_LOCAL_STORAGE] = storage_ann
-            out.append(p)
+        return _expand_replicas(
+            obj, STATEFULSET, spec.get("template") or {},
+            spec.get("replicas", 1), STATEFULSET, name, namespace,
+            name_fn=lambda ordinal: f"{name}-{ordinal}",
+            # unconditional: volumeClaimTemplates are the source of truth for
+            # the storage annotation, overriding any template-supplied value
+            # (utils.go:246-292 always assigns)
+            force_annotations=(
+                {ANNO_POD_LOCAL_STORAGE: storage_ann} if storage_ann else None
+            ),
+        )
     elif kind == JOB:
-        completions = spec.get("completions", 1)
-        template = spec.get("template") or {}
-        for _ in range(int(completions if completions is not None else 1)):
-            p = make_valid_pod_dict(_pod_dict_from_template(obj, JOB, template))
-            out.append(_add_workload_info(p, JOB, name, namespace))
+        return _expand_replicas(
+            obj, JOB, spec.get("template") or {},
+            spec.get("completions", 1), JOB, name, namespace, name_fn=None,
+        )
     elif kind == CRONJOB:
         job_spec = (spec.get("jobTemplate") or {}).get("spec") or {}
-        completions = job_spec.get("completions", 1)
-        template = job_spec.get("template") or {}
-        for _ in range(int(completions if completions is not None else 1)):
-            p = make_valid_pod_dict(_pod_dict_from_template(obj, JOB, template))
-            p["metadata"]["annotations"].setdefault(
-                "cronjob.kubernetes.io/instantiate", "manual"
-            )
-            out.append(_add_workload_info(p, JOB, name, namespace))
+        return _expand_replicas(
+            obj, JOB, job_spec.get("template") or {},
+            job_spec.get("completions", 1), JOB, name, namespace,
+            name_fn=None,
+            extra_annotations={"cronjob.kubernetes.io/instantiate": "manual"},
+        )
     elif kind == DAEMONSET:
         return daemonset_pods(obj, nodes or [])
     else:
         raise ValueError(f"unsupported workload kind: {kind}")
     return [Pod.from_dict(p) for p in out]
+
+
+def _expand_replicas(
+    owner: dict,
+    owner_kind: str,
+    template: dict,
+    count,
+    info_kind: str,
+    name: str,
+    namespace: str,
+    name_fn,
+    extra_annotations: Optional[Dict[str, str]] = None,
+    force_annotations: Optional[Dict[str, str]] = None,
+) -> List[Pod]:
+    """Expand one template into `count` replica Pods: the first replica is
+    fully synthesized + validated + parsed (the reference's MakeValidPod path,
+    utils.go:139-171,378-463), the rest are cheap clones of that prototype —
+    replicas are spec-identical by construction. extra_annotations are
+    defaults (template wins); force_annotations always overwrite."""
+    n = int(count if count is not None else 1)
+    if n <= 0:
+        return []
+    d = make_valid_pod_dict(_pod_dict_from_template(owner, owner_kind, template))
+    _add_workload_info(d, info_kind, name, namespace)
+    if extra_annotations:
+        for k, v in extra_annotations.items():
+            d["metadata"]["annotations"].setdefault(k, v)
+    if force_annotations:
+        d["metadata"]["annotations"].update(force_annotations)
+    if name_fn is not None:
+        d["metadata"]["name"] = name_fn(0)
+    proto = Pod.from_dict(d)
+    pods = [proto]
+    for i in range(1, n):
+        pod_name = name_fn(i) if name_fn is not None else f"{name}-{_rand_suffix()}"
+        pods.append(_clone_pod(proto, pod_name))
+    return pods
 
 
 def daemonset_pods(ds: dict, nodes: List[Node]) -> List[Pod]:

@@ -1,0 +1,109 @@
+"""ctypes loader for the native host runtime (osim_native.cpp).
+
+Degrades gracefully: if the shared library is missing it is compiled on
+demand with g++ (the toolchain baked into the image); if that fails, every
+entry point reports unavailable and callers keep their pure-Python paths.
+The reference's host layer is compiled Go — this is the TPU build's
+equivalent compiled layer for host-side hot loops (SURVEY §2.4).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libosim_native.so")
+_SRC = os.path.join(_DIR, "osim_native.cpp")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        proc = subprocess.run(
+            ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", _SRC, "-o", _SO],
+            capture_output=True,
+            timeout=120,
+        )
+        return proc.returncode == 0 and os.path.exists(_SO)
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The shared library, building it on first use; None when unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.osim_hash_rows.argtypes = [
+            np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+            ctypes.c_int64,
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS"),
+        ]
+        lib.osim_hash_rows.restype = None
+        lib.osim_parse_quantity_one.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.osim_parse_quantity_one.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def parse_quantity_one(s: str) -> Optional[Tuple[int, int, int, int]]:
+    """Scalar fast path: (milli_ceil, milli_floor, base_ceil, base_floor), or
+    None when unavailable / the value needs the exact Python path."""
+    lib = load()
+    if lib is None:
+        return None
+    b = s.encode()
+    mc = ctypes.c_int64()
+    mf = ctypes.c_int64()
+    bc = ctypes.c_int64()
+    bf = ctypes.c_int64()
+    if not lib.osim_parse_quantity_one(
+        b, len(b),
+        ctypes.byref(mc), ctypes.byref(mf), ctypes.byref(bc), ctypes.byref(bf),
+    ):
+        return None
+    return mc.value, mf.value, bc.value, bf.value
+
+
+def hash_rows(data: np.ndarray) -> Optional[np.ndarray]:
+    """128-bit hash per row of a 2-D uint8 array -> uint64[n, 2], or None
+    when the native library is unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    data = np.ascontiguousarray(data, np.uint8)
+    n, row_bytes = data.shape
+    out = np.zeros((n, 2), np.uint64)
+    lib.osim_hash_rows(data, n, row_bytes, out)
+    return out

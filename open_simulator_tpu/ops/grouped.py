@@ -202,9 +202,9 @@ _group_jit = jax.jit(schedule_group, static_argnames=("group_size",))
 
 
 def _row_signature(batch: PodBatch) -> np.ndarray:
-    """Byte-hash every pod row's feature arrays to detect identical specs."""
-    import hashlib
-
+    """Byte-hash every pod row's feature arrays to detect identical specs.
+    Uses the compiled 128-bit row hasher (native/osim_native.cpp) when
+    available; blake2b otherwise."""
     from dataclasses import fields
 
     parts = []
@@ -214,7 +214,18 @@ def _row_signature(batch: PodBatch) -> np.ndarray:
         arr = getattr(batch, f.name)
         parts.append(np.ascontiguousarray(arr).reshape(batch.p, -1).view(np.uint8))
     blob = np.concatenate(parts, axis=1)
-    return np.array([hashlib.blake2b(row.tobytes(), digest_size=8).digest() for row in blob])
+
+    from ..native import hash_rows
+
+    hashed = hash_rows(blob)
+    if hashed is not None:
+        return hashed.view([("a", np.uint64), ("b", np.uint64)]).reshape(-1)
+
+    import hashlib
+
+    return np.array(
+        [hashlib.blake2b(row.tobytes(), digest_size=8).digest() for row in blob]
+    )
 
 
 def group_runs(batch: PodBatch) -> List[Tuple[int, int]]:

@@ -1,0 +1,80 @@
+"""Replica expansion via prototype cloning: per-replica independence and the
+volumeClaimTemplates annotation override (utils.go:139-171, 246-292)."""
+
+import json
+
+from open_simulator_tpu.core.objects import ANNO_POD_LOCAL_STORAGE
+from open_simulator_tpu.core.workloads import pods_from_workload
+
+
+def test_sts_storage_annotation_overrides_template_value():
+    sts = {
+        "kind": "StatefulSet",
+        "metadata": {"name": "db", "namespace": "d"},
+        "spec": {
+            "replicas": 2,
+            "template": {
+                "metadata": {
+                    # stale hand-written value: volumeClaimTemplates win
+                    "annotations": {ANNO_POD_LOCAL_STORAGE: '{"volumes": []}'}
+                },
+                "spec": {
+                    "containers": [
+                        {"name": "c", "resources": {"requests": {"cpu": "1"}}}
+                    ]
+                },
+            },
+            "volumeClaimTemplates": [
+                {
+                    "spec": {
+                        "storageClassName": "open-local-lvm",
+                        "resources": {"requests": {"storage": "8Gi"}},
+                    }
+                }
+            ],
+        },
+    }
+    pods = pods_from_workload(sts)
+    assert len(pods) == 2
+    for p in pods:
+        vols = json.loads(p.meta.annotations[ANNO_POD_LOCAL_STORAGE])["volumes"]
+        assert vols and vols[0]["scName"] == "open-local-lvm"
+
+
+def test_clone_independence():
+    dep = {
+        "kind": "Deployment",
+        "metadata": {"name": "web", "namespace": "d"},
+        "spec": {
+            "replicas": 3,
+            "template": {
+                "metadata": {"labels": {"app": "web"}},
+                "spec": {
+                    "containers": [
+                        {"name": "c", "resources": {"requests": {"cpu": "1"}}}
+                    ]
+                },
+            },
+        },
+    }
+    pods = pods_from_workload(dep)
+    assert len({p.meta.name for p in pods}) == 3
+    pods[0].meta.annotations["k"] = "v"
+    pods[0].meta.labels["l"] = "v"
+    pods[0].requests["cpu"] = 999
+    pods[0].node_name = "n1"
+    assert "k" not in pods[1].meta.annotations
+    assert "l" not in pods[1].meta.labels
+    assert pods[1].requests["cpu"] == 1000
+    assert pods[1].node_name == ""
+    # raw metadata names follow the clone
+    assert pods[1].raw["metadata"]["name"] == pods[1].meta.name
+
+
+def test_zero_replicas():
+    dep = {
+        "kind": "Deployment",
+        "metadata": {"name": "w", "namespace": "d"},
+        "spec": {"replicas": 0, "template": {"spec": {"containers": []}}},
+    }
+    assert pods_from_workload(dep) == []
