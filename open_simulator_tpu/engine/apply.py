@@ -48,24 +48,34 @@ def build_cluster(cfg: SimonConfig) -> ClusterResource:
 
 
 def render_chart(path: str, name: str) -> List[dict]:
-    """Helm chart rendering. Uses the helm binary when present; otherwise a
-    clear error (the reference links helm v3 as a library, `pkg/chart/chart.go`)."""
-    helm = shutil.which("helm")
-    if helm is None:
-        raise ApplyError(
-            f"app {name}: chart rendering requires the helm binary, which is "
-            "not installed; pre-render the chart (helm template) and point the "
-            "app path at the output directory instead"
+    """Helm chart rendering (parity: chart.ProcessChart, pkg/chart/chart.go).
+
+    The built-in renderer (utils/chart.py) handles the Go-template subset
+    application charts use; charts beyond that subset fall back to a real
+    `helm template` binary when one is installed."""
+    from ..utils.chart import ChartError, process_chart
+
+    try:
+        return process_chart(path, release_name=name)
+    except ChartError as e:
+        helm = shutil.which("helm")
+        if helm is None:
+            raise ApplyError(
+                f"app {name}: built-in chart renderer: {e} (and no helm "
+                "binary is installed to fall back to; pre-render with "
+                "`helm template` and point the app path at the output)"
+            )
+        proc = subprocess.run(
+            [helm, "template", name, path],
+            capture_output=True,
+            text=True,
+            check=False,
         )
-    proc = subprocess.run(
-        [helm, "template", name, path],
-        capture_output=True,
-        text=True,
-        check=False,
-    )
-    if proc.returncode != 0:
-        raise ApplyError(f"helm template failed for {name}: {proc.stderr.strip()}")
-    return load_yaml_documents(proc.stdout)
+        if proc.returncode != 0:
+            raise ApplyError(
+                f"helm template failed for {name}: {proc.stderr.strip()}"
+            )
+        return load_yaml_documents(proc.stdout)
 
 
 def build_apps(cfg: SimonConfig) -> List[AppResource]:

@@ -1,0 +1,548 @@
+"""Built-in Helm chart rendering.
+
+Parity target: `/root/reference/pkg/chart/chart.go` (ProcessChart →
+load → installable check → render values {Chart, Release{Name=chart name,
+Namespace=default, Revision=1, Service=Helm}, Values} → engine.Render → strip
+NOTES.txt → SortManifests by InstallOrder). The reference links Helm v3 as a
+library; this is a from-scratch renderer for the Go-template subset that
+Kubernetes application charts actually use:
+
+  - {{ .path.to.value }} / {{ $.rooted.path }} lookups with `-` trim markers
+  - pipelines with the common helpers: default, quote, squote, upper, lower,
+    trim, int, toString, indent, nindent, toYaml
+  - block actions: if / else if / else / end, range (lists and dicts),
+    with / end — nested arbitrarily
+  - literals: "str", 'str', `str`, ints, floats, true/false/nil
+
+Charts may be directories or .tgz archives; dependency charts under charts/
+render recursively with subchart-scoped values (values.<name> overlaid onto
+the subchart's own values, plus shared .Values.global). Templates using
+constructs outside this subset raise ChartError with the offending action —
+the apply layer falls back to a real `helm template` binary when present.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import tarfile
+import tempfile
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import yaml
+
+NOTES_SUFFIX = "NOTES.txt"
+
+INSTALL_ORDER = [
+    "Namespace", "NetworkPolicy", "ResourceQuota", "LimitRange",
+    "PodSecurityPolicy", "PodDisruptionBudget", "ServiceAccount", "Secret",
+    "SecretList", "ConfigMap", "StorageClass", "PersistentVolume",
+    "PersistentVolumeClaim", "CustomResourceDefinition", "ClusterRole",
+    "ClusterRoleList", "ClusterRoleBinding", "ClusterRoleBindingList",
+    "Role", "RoleList", "RoleBinding", "RoleBindingList", "Service",
+    "DaemonSet", "Pod", "ReplicationController", "ReplicaSet", "Deployment",
+    "HorizontalPodAutoscaler", "StatefulSet", "Job", "CronJob",
+    "IngressClass", "Ingress", "APIService",
+]
+_ORDER_INDEX = {k: i for i, k in enumerate(INSTALL_ORDER)}
+
+
+class ChartError(Exception):
+    pass
+
+
+@dataclass
+class Chart:
+    name: str
+    metadata: Dict[str, Any]
+    values: Dict[str, Any]
+    templates: Dict[str, str]            # relative path -> text
+    dependencies: List["Chart"] = field(default_factory=list)
+
+
+def load_chart(path: str) -> Chart:
+    """Load a chart from a directory or a .tgz archive. Everything is read
+    into memory; extracted archives are removed before returning."""
+    if os.path.isfile(path) and (path.endswith(".tgz") or path.endswith(".tar.gz")):
+        tmp = tempfile.mkdtemp(prefix="osim-chart-")
+        try:
+            with tarfile.open(path, "r:gz") as tf:
+                # "data" filter rejects traversal, link escapes, devices
+                tf.extractall(tmp, filter="data")
+            entries = [
+                e for e in os.listdir(tmp) if os.path.isdir(os.path.join(tmp, e))
+            ]
+            if len(entries) != 1:
+                raise ChartError(
+                    f"chart archive must contain one root dir, got {entries}"
+                )
+            return _load_chart_dir(os.path.join(tmp, entries[0]))
+        except tarfile.TarError as e:
+            raise ChartError(f"unreadable chart archive {path}: {e}")
+        finally:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+    return _load_chart_dir(path)
+
+
+def _load_chart_dir(path: str) -> Chart:
+    if not os.path.isdir(path):
+        raise ChartError(f"chart path not found: {path}")
+
+    meta_path = os.path.join(path, "Chart.yaml")
+    if not os.path.exists(meta_path):
+        raise ChartError(f"{path}: Chart.yaml not found")
+    with open(meta_path) as fh:
+        metadata = yaml.safe_load(fh) or {}
+    ctype = metadata.get("type", "")
+    if ctype not in ("", "application", None):
+        # checkIfInstallable parity (chart.go:45-51)
+        raise ChartError(f"{ctype} charts are not installable")
+
+    values: Dict[str, Any] = {}
+    vals_path = os.path.join(path, "values.yaml")
+    if os.path.exists(vals_path):
+        with open(vals_path) as fh:
+            values = yaml.safe_load(fh) or {}
+
+    templates: Dict[str, str] = {}
+    tdir = os.path.join(path, "templates")
+    if os.path.isdir(tdir):
+        for root, _, files in os.walk(tdir):
+            for f in sorted(files):
+                full = os.path.join(root, f)
+                rel = os.path.relpath(full, path)
+                with open(full) as fh:
+                    templates[rel] = fh.read()
+
+    deps: List[Chart] = []
+    cdir = os.path.join(path, "charts")
+    if os.path.isdir(cdir):
+        for entry in sorted(os.listdir(cdir)):
+            sub = os.path.join(cdir, entry)
+            if os.path.isdir(sub) or entry.endswith(".tgz"):
+                deps.append(load_chart(sub))
+
+    name = metadata.get("name") or os.path.basename(path.rstrip("/"))
+    return Chart(
+        name=name, metadata=metadata, values=values, templates=templates,
+        dependencies=deps,
+    )
+
+
+# ---------------------------------------------------------------------------
+# The template engine (Go text/template subset)
+# ---------------------------------------------------------------------------
+
+_ACTION_RE = re.compile(r"\{\{(-?)\s*(.*?)\s*(-?)\}\}", re.DOTALL)
+
+
+@dataclass
+class _Node:
+    kind: str                 # text | action | if | range | with
+    text: str = ""
+    expr: str = ""
+    body: list = field(default_factory=list)
+    elifs: list = field(default_factory=list)   # [(expr, body), ...]
+    else_body: Optional[list] = None
+
+
+def _tokenize_with_positions(src: str):
+    """[(kind, payload)]: kind 'text' or 'action'. Trim markers apply to
+    adjacent text the way Go templates do ('{{-' eats preceding whitespace,
+    '-}}' eats following whitespace)."""
+    tokens: List[Tuple[str, str]] = []
+    pos = 0
+    pending_trim = False
+    for m in _ACTION_RE.finditer(src):
+        text = src[pos : m.start()]
+        if pending_trim:
+            text = text.lstrip(" \t\n\r")
+        if m.group(1) == "-":
+            text = text.rstrip(" \t\n\r")
+        tokens.append(("text", text))
+        tokens.append(("action", m.group(2)))
+        pending_trim = m.group(3) == "-"
+        pos = m.end()
+    tail = src[pos:]
+    if pending_trim:
+        tail = tail.lstrip(" \t\n\r")
+    tokens.append(("text", tail))
+    return tokens
+
+
+def _stop_word(payload: str) -> str:
+    parts = payload.split(None, 1)
+    return parts[0] if parts else ""
+
+
+def _parse(tokens, i=0, stop=()):
+    """Recursive-descent parse into a node list; returns (nodes, next_index,
+    stop_payload). A block body that runs out of tokens before its terminator
+    raises ChartError; a stray end/else at the top level does too."""
+    nodes: List[_Node] = []
+    while i < len(tokens):
+        kind, payload = tokens[i]
+        if kind == "text":
+            if payload:
+                nodes.append(_Node("text", text=payload))
+            i += 1
+            continue
+        word = _stop_word(payload)
+        if word in stop:
+            return nodes, i, payload
+
+        def block_body(j, allow_else=True):
+            terms = ("end", "else") if allow_else else ("end",)
+            body, j2, stop_payload = _parse(tokens, j, stop=terms)
+            if not stop_payload:
+                raise ChartError("unterminated block action (missing {{ end }})")
+            return body, j2, stop_payload
+
+        if word == "if":
+            expr = payload[2:].strip()
+            body, i, stop_payload = block_body(i + 1)
+            node = _Node("if", expr=expr, body=body)
+            while _stop_word(stop_payload) == "else":
+                rest = stop_payload[4:].strip()
+                if rest.startswith("if "):
+                    sub_body, i, stop_payload = block_body(i + 1)
+                    node.elifs.append((rest[3:].strip(), sub_body))
+                else:
+                    node.else_body, i, stop_payload = block_body(
+                        i + 1, allow_else=False
+                    )
+                    break
+            nodes.append(node)
+            i += 1  # past 'end'
+        elif word in ("range", "with"):
+            expr = payload[len(word):].strip()
+            body, i, stop_payload = block_body(i + 1)
+            node = _Node(word, expr=expr, body=body)
+            if _stop_word(stop_payload) == "else":
+                node.else_body, i, _ = block_body(i + 1, allow_else=False)
+            nodes.append(node)
+            i += 1
+        elif word in ("end", "else"):
+            raise ChartError(f"unexpected {{{{ {word} }}}} outside a block")
+        else:
+            nodes.append(_Node("action", expr=payload))
+            i += 1
+    return nodes, i, ""
+
+
+_STR_LIT = re.compile(r'^"((?:[^"\\]|\\.)*)"$|' r"^'((?:[^'\\]|\\.)*)'$|^`([^`]*)`$")
+
+_ESCAPES = {"n": "\n", "t": "\t", "r": "\r", '"': '"', "'": "'", "\\": "\\", "0": "\0"}
+
+
+def _unescape(s: str) -> str:
+    """Go string-literal escapes, unicode-safe (a bytes/unicode_escape round
+    trip would mangle non-ASCII source characters)."""
+    return re.sub(r"\\(.)", lambda m: _ESCAPES.get(m.group(1), m.group(1)), s)
+
+
+class _Renderer:
+    def __init__(self, root: Dict[str, Any]):
+        self.root = root
+
+    # -- expression evaluation ---------------------------------------------
+    def _lookup(self, path: str, dot: Any) -> Any:
+        base = self.root if path.startswith("$") else dot
+        trimmed = path.lstrip("$")
+        if trimmed in ("", "."):
+            return base
+        cur = base
+        for part in trimmed.strip(".").split("."):
+            if isinstance(cur, dict):
+                cur = cur.get(part)
+            else:
+                cur = getattr(cur, part, None)
+            if cur is None:
+                return None
+        return cur
+
+    def _atom(self, tok: str, dot: Any) -> Any:
+        m = _STR_LIT.match(tok)
+        if m:
+            s = next(g for g in m.groups() if g is not None)
+            if tok.startswith("`"):
+                return s  # raw string: no escapes
+            return _unescape(s)
+        if tok == "true":
+            return True
+        if tok == "false":
+            return False
+        if tok in ("nil", "null"):
+            return None
+        if re.fullmatch(r"[+-]?\d+", tok):
+            return int(tok)
+        if re.fullmatch(r"[+-]?\d*\.\d+", tok):
+            return float(tok)
+        if tok.startswith(".") or tok.startswith("$"):
+            return self._lookup(tok, dot)
+        raise ChartError(f"unsupported template expression: {tok!r}")
+
+    def _call(self, fn: str, args: List[Any]) -> Any:
+        if fn == "default":
+            # default DEFAULT VALUE: VALUE if truthy else DEFAULT
+            if len(args) != 2:
+                raise ChartError("default expects 2 arguments")
+            return args[1] if _truthy(args[1]) else args[0]
+        if fn == "quote":
+            return '"' + _to_string(args[0]).replace('"', '\\"') + '"'
+        if fn == "squote":
+            return "'" + _to_string(args[0]) + "'"
+        if fn == "upper":
+            return _to_string(args[0]).upper()
+        if fn == "lower":
+            return _to_string(args[0]).lower()
+        if fn == "trim":
+            return _to_string(args[0]).strip()
+        if fn == "int":
+            try:
+                return int(float(args[0]))
+            except (TypeError, ValueError):
+                return 0
+        if fn == "toString":
+            return _to_string(args[0])
+        if fn == "toYaml":
+            return yaml.safe_dump(args[0], default_flow_style=False).rstrip("\n")
+        if fn == "indent" or fn == "nindent":
+            n, s = int(args[0]), _to_string(args[1])
+            pad = " " * n
+            body = "\n".join(pad + line for line in s.split("\n"))
+            return ("\n" + body) if fn == "nindent" else body
+        if fn == "not":
+            return not _truthy(args[0])
+        if fn in ("eq", "ne", "lt", "le", "gt", "ge"):
+            a, b = args[0], args[1]
+            try:
+                return {
+                    "eq": a == b, "ne": a != b, "lt": a < b,
+                    "le": a <= b, "gt": a > b, "ge": a >= b,
+                }[fn]
+            except TypeError:
+                return False
+        if fn == "and":
+            out = args[0]
+            for a in args:
+                if not _truthy(a):
+                    return a
+                out = a
+            return out
+        if fn == "or":
+            for a in args:
+                if _truthy(a):
+                    return a
+            return args[-1]
+        raise ChartError(f"unsupported template function: {fn!r}")
+
+    def _eval(self, expr: str, dot: Any) -> Any:
+        expr = expr.strip()
+        if not expr:
+            return None
+        # pipeline: split on | at top level (no parens support beyond one level)
+        stages = _split_top(expr, "|")
+        value: Any = None
+        first = True
+        for stage in stages:
+            toks = _split_top(stage.strip(), " ")
+            if not toks:
+                continue
+            head = toks[0]
+            if first and (
+                head.startswith(".") or head.startswith("$") or _STR_LIT.match(head)
+                or head in ("true", "false", "nil", "null")
+                or re.fullmatch(r"[+-]?\d+(\.\d+)?", head)
+            ):
+                if len(toks) != 1:
+                    raise ChartError(f"unsupported template expression: {stage!r}")
+                value = self._atom(head, dot)
+            else:
+                args = [self._atom(t, dot) for t in toks[1:]]
+                if not first:
+                    args.append(value)
+                value = self._call(head, args)
+            first = False
+        return value
+
+    # -- rendering ----------------------------------------------------------
+    def render_nodes(self, nodes: List[_Node], dot: Any) -> str:
+        out: List[str] = []
+        for node in nodes:
+            if node.kind == "text":
+                out.append(node.text)
+            elif node.kind == "action":
+                word = node.expr.split(None, 1)[0] if node.expr else ""
+                if word in ("define", "template", "include", "block"):
+                    raise ChartError(
+                        f"unsupported template action: {node.expr!r}"
+                    )
+                if node.expr.startswith("/*") or word == "":
+                    continue  # comment
+                val = self._eval(node.expr, dot)
+                out.append(_to_string(val))
+            elif node.kind == "if":
+                if _truthy(self._eval(node.expr, dot)):
+                    out.append(self.render_nodes(node.body, dot))
+                else:
+                    done = False
+                    for cond, body in node.elifs:
+                        if _truthy(self._eval(cond, dot)):
+                            out.append(self.render_nodes(body, dot))
+                            done = True
+                            break
+                    if not done and node.else_body is not None:
+                        out.append(self.render_nodes(node.else_body, dot))
+            elif node.kind == "range":
+                coll = self._eval(node.expr, dot)
+                items: List[Any]
+                if isinstance(coll, dict):
+                    items = [coll[k] for k in coll]
+                elif isinstance(coll, (list, tuple)):
+                    items = list(coll)
+                else:
+                    items = []
+                if items:
+                    for item in items:
+                        out.append(self.render_nodes(node.body, item))
+                elif node.else_body is not None:
+                    out.append(self.render_nodes(node.else_body, dot))
+            elif node.kind == "with":
+                val = self._eval(node.expr, dot)
+                if _truthy(val):
+                    out.append(self.render_nodes(node.body, val))
+                elif node.else_body is not None:
+                    out.append(self.render_nodes(node.else_body, dot))
+        return "".join(out)
+
+
+def _split_top(s: str, sep: str) -> List[str]:
+    """Split on sep outside quotes."""
+    parts: List[str] = []
+    cur: List[str] = []
+    quote = ""
+    for ch in s:
+        if quote:
+            cur.append(ch)
+            if ch == quote:
+                quote = ""
+        elif ch in "\"'`":
+            quote = ch
+            cur.append(ch)
+        elif ch == sep:
+            if "".join(cur).strip():
+                parts.append("".join(cur).strip())
+            cur = []
+        else:
+            cur.append(ch)
+    if "".join(cur).strip():
+        parts.append("".join(cur).strip())
+    return parts
+
+
+def _truthy(v: Any) -> bool:
+    """Go template truthiness: false, 0, empty string/collection, nil."""
+    if v is None or v is False:
+        return False
+    if isinstance(v, (int, float)) and v == 0:
+        return False
+    if isinstance(v, (str, list, dict, tuple)) and len(v) == 0:
+        return False
+    return True
+
+
+def _to_string(v: Any) -> str:
+    if v is None:
+        return ""
+    if v is True:
+        return "true"
+    if v is False:
+        return "false"
+    return str(v)
+
+
+def render_template(src: str, context: Dict[str, Any]) -> str:
+    tokens = _tokenize_with_positions(src)
+    nodes, _, _ = _parse(tokens)
+    return _Renderer(context).render_nodes(nodes, context)
+
+
+# ---------------------------------------------------------------------------
+# ProcessChart
+# ---------------------------------------------------------------------------
+
+def _coalesce(base: Dict[str, Any], overlay: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(base)
+    for k, v in overlay.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _coalesce(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def _render_chart_files(
+    chart: Chart, values: Dict[str, Any], release_name: str
+) -> Dict[str, str]:
+    ctx = {
+        "Chart": chart.metadata,
+        "Release": {
+            # chart.go:27-61: the app name overwrites Chart.Metadata.Name
+            # before rendering, so Release.Name is the APP name (also what
+            # `helm template <name> <path>` does); ns/revision hardcoded
+            "Name": release_name,
+            "Namespace": "default",
+            "Revision": 1,
+            "Service": "Helm",
+        },
+        "Values": values,
+    }
+    files: Dict[str, str] = {}
+    for rel, src in chart.templates.items():
+        if rel.startswith(os.path.join("templates", "_")):
+            continue  # partials unsupported; skipped unless referenced
+        files[os.path.join(chart.name, rel)] = render_template(src, ctx)
+    # dependencies: subchart values live under values.<subchart name>,
+    # sharing .Values.global and the parent's release name
+    for dep in chart.dependencies:
+        sub_vals = _coalesce(dep.values, values.get(dep.name) or {})
+        if "global" in values:
+            sub_vals = _coalesce(sub_vals, {"global": values["global"]})
+        files.update(_render_chart_files(dep, sub_vals, release_name))
+    return files
+
+
+def process_chart(path: str, release_name: Optional[str] = None) -> List[dict]:
+    """Render a chart into decoded manifest objects in Helm install order
+    (parity: chart.ProcessChart, pkg/chart/chart.go:27-118). release_name is
+    the app name from the Simon config; defaults to the chart's own name."""
+    chart = load_chart(path)
+    files = _render_chart_files(
+        chart, chart.values, release_name or chart.name
+    )
+
+    docs: List[Tuple[int, int, dict]] = []  # (order, seq, object)
+    seq = 0
+    for rel in sorted(files):
+        if rel.endswith(NOTES_SUFFIX):
+            continue
+        content = files[rel]
+        for doc in re.split(r"(?m)^---\s*$", content):
+            if not doc.strip():
+                continue
+            try:
+                obj = yaml.safe_load(doc)
+            except yaml.YAMLError as e:
+                raise ChartError(f"{rel}: rendered template is not YAML: {e}")
+            if not isinstance(obj, dict) or not obj:
+                continue
+            kind = obj.get("kind", "")
+            order = _ORDER_INDEX.get(kind, len(INSTALL_ORDER))
+            docs.append((order, seq, obj))
+            seq += 1
+    docs.sort(key=lambda t: (t[0], t[1]))
+    return [d for _, _, d in docs]

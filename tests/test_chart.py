@@ -1,0 +1,267 @@
+"""Built-in Helm chart rendering (utils/chart.py).
+
+Parity target: pkg/chart/chart.go (ProcessChart: load, installable check,
+Release context, render, NOTES.txt strip, InstallOrder sort) plus the
+Go-template subset the engine implements.
+"""
+
+import os
+import textwrap
+
+import pytest
+import yaml
+
+from open_simulator_tpu.utils.chart import (
+    ChartError,
+    load_chart,
+    process_chart,
+    render_template,
+)
+
+
+# ---------------------------------------------------------------------------
+# template engine
+# ---------------------------------------------------------------------------
+
+CTX = {
+    "Values": {
+        "name": "web",
+        "replicas": 3,
+        "enabled": True,
+        "tag": "",
+        "items": ["a", "b"],
+        "nested": {"image": "nginx", "port": 8080},
+    },
+    "Release": {"Name": "rel", "Namespace": "default"},
+    "Chart": {"name": "c", "version": "1.0"},
+}
+
+
+def test_lookup_and_literals():
+    assert render_template("{{ .Values.name }}", CTX) == "web"
+    assert render_template("{{ .Values.nested.image }}", CTX) == "nginx"
+    assert render_template("{{ $.Release.Name }}", CTX) == "rel"
+    assert render_template('{{ "lit" }}', CTX) == "lit"
+    assert render_template("{{ 42 }}", CTX) == "42"
+    assert render_template("{{ .Values.missing }}", CTX) == ""
+
+
+def test_trim_markers():
+    src = "a\n  {{- .Values.name }}\nb"
+    assert render_template(src, CTX) == "aweb\nb"
+    # '-}}' eats ALL following whitespace (Go text/template semantics)
+    src = "a {{ .Values.name -}}\n  b"
+    assert render_template(src, CTX) == "a webb"
+
+
+def test_if_else_end():
+    src = "{{ if .Values.enabled }}on{{ else }}off{{ end }}"
+    assert render_template(src, CTX) == "on"
+    src = "{{ if .Values.tag }}t{{ else }}empty{{ end }}"
+    assert render_template(src, CTX) == "empty"
+    src = "{{ if .Values.tag }}a{{ else if .Values.enabled }}b{{ else }}c{{ end }}"
+    assert render_template(src, CTX) == "b"
+
+
+def test_nested_if():
+    src = (
+        "{{ if .Values.enabled }}{{ if .Values.tag }}x{{ else }}y{{ end }}"
+        "{{ else }}z{{ end }}"
+    )
+    assert render_template(src, CTX) == "y"
+
+
+def test_range_and_with():
+    assert render_template("{{ range .Values.items }}[{{ . }}]{{ end }}", CTX) == "[a][b]"
+    assert (
+        render_template(
+            "{{ with .Values.nested }}{{ .image }}:{{ .port }}{{ end }}", CTX
+        )
+        == "nginx:8080"
+    )
+    assert render_template("{{ range .Values.missing }}x{{ else }}none{{ end }}", CTX) == "none"
+
+
+def test_pipeline_functions():
+    assert render_template('{{ .Values.tag | default "latest" }}', CTX) == "latest"
+    assert render_template('{{ .Values.name | default "x" }}', CTX) == "web"
+    assert render_template("{{ .Values.name | upper }}", CTX) == "WEB"
+    assert render_template("{{ .Values.name | quote }}", CTX) == '"web"'
+    assert render_template("{{ int .Values.replicas }}", CTX) == "3"
+    assert render_template('{{ eq .Values.name "web" }}', CTX) == "true"
+    assert render_template("{{ not .Values.enabled }}", CTX) == "false"
+
+
+def test_unsupported_constructs_raise():
+    with pytest.raises(ChartError):
+        render_template('{{ include "chart.labels" . }}', CTX)
+    with pytest.raises(ChartError):
+        render_template("{{ template \"x\" }}", CTX)
+    with pytest.raises(ChartError):
+        render_template("{{ unknownfn .Values.name }}", CTX)
+
+
+def test_malformed_blocks_raise_chart_error():
+    with pytest.raises(ChartError):
+        render_template("{{ if .Values.enabled }}no end", CTX)
+    with pytest.raises(ChartError):
+        render_template("{{ range .Values.items }}x", CTX)
+    with pytest.raises(ChartError):
+        render_template("text {{ end }} more", CTX)
+    with pytest.raises(ChartError):
+        render_template("{{ else }}", CTX)
+
+
+def test_non_ascii_string_literals():
+    assert render_template('{{ "café" }}', CTX) == "café"
+    assert render_template('{{ "a\\nb" }}', CTX) == "a\nb"
+    assert render_template('{{ `raw\\n` }}', CTX) == "raw\\n"
+
+
+# ---------------------------------------------------------------------------
+# chart loading + ProcessChart
+# ---------------------------------------------------------------------------
+
+def _write_chart(root, name="demo", values=None, templates=None, meta_extra=""):
+    cdir = os.path.join(root, name)
+    os.makedirs(os.path.join(cdir, "templates"), exist_ok=True)
+    with open(os.path.join(cdir, "Chart.yaml"), "w") as fh:
+        fh.write(f"apiVersion: v2\nname: {name}\nversion: 0.1.0\n{meta_extra}")
+    with open(os.path.join(cdir, "values.yaml"), "w") as fh:
+        yaml.safe_dump(values or {}, fh)
+    for rel, src in (templates or {}).items():
+        with open(os.path.join(cdir, "templates", rel), "w") as fh:
+            fh.write(src)
+    return cdir
+
+
+def test_process_chart_renders_and_sorts(tmp_path):
+    cdir = _write_chart(
+        tmp_path,
+        values={"replicas": 2, "image": "nginx"},
+        templates={
+            "deploy.yaml": textwrap.dedent(
+                """\
+                apiVersion: apps/v1
+                kind: Deployment
+                metadata:
+                  name: {{ .Release.Name }}-web
+                spec:
+                  replicas: {{ .Values.replicas }}
+                  template:
+                    spec:
+                      containers:
+                      - name: c
+                        image: {{ .Values.image }}
+                """
+            ),
+            "ns.yaml": "apiVersion: v1\nkind: Namespace\nmetadata:\n  name: n\n",
+            "NOTES.txt": "thanks for installing {{ .Release.Name }}",
+        },
+    )
+    docs = process_chart(cdir, release_name="myapp")
+    kinds = [d["kind"] for d in docs]
+    # Namespace sorts before Deployment; NOTES.txt stripped
+    assert kinds == ["Namespace", "Deployment"]
+    dep = docs[1]
+    # Release.Name is the APP name (chart.go overwrites Metadata.Name)
+    assert dep["metadata"]["name"] == "myapp-web"
+    assert dep["spec"]["replicas"] == 2
+    # default: chart's own name
+    assert process_chart(cdir)[1]["metadata"]["name"] == "demo-web"
+
+
+def test_library_charts_rejected(tmp_path):
+    cdir = _write_chart(tmp_path, name="lib", meta_extra="type: library\n")
+    with pytest.raises(ChartError):
+        process_chart(cdir)
+
+
+def test_subchart_values_scoping(tmp_path):
+    parent = _write_chart(
+        tmp_path,
+        name="parent",
+        values={"sub": {"msg": "from-parent"}},
+        templates={
+            "cm.yaml": "kind: ConfigMap\nmetadata:\n  name: parent-cm\n",
+        },
+    )
+    subdir = os.path.join(parent, "charts")
+    os.makedirs(subdir)
+    _write_chart(
+        subdir,
+        name="sub",
+        values={"msg": "own-default", "keep": "kept"},
+        templates={
+            "cm.yaml": (
+                "kind: ConfigMap\nmetadata:\n  name: sub-cm\ndata:\n"
+                "  msg: {{ .Values.msg }}\n  keep: {{ .Values.keep }}\n"
+            ),
+        },
+    )
+    objs = process_chart(parent)
+    sub_cm = next(o for o in objs if o["metadata"]["name"] == "sub-cm")
+    assert sub_cm["data"]["msg"] == "from-parent"   # parent override wins
+    assert sub_cm["data"]["keep"] == "kept"         # own defaults survive
+
+
+def test_tgz_chart(tmp_path):
+    import tarfile
+
+    cdir = _write_chart(
+        tmp_path,
+        templates={"cm.yaml": "kind: ConfigMap\nmetadata:\n  name: x\n"},
+    )
+    tgz = os.path.join(tmp_path, "demo.tgz")
+    with tarfile.open(tgz, "w:gz") as tf:
+        tf.add(cdir, arcname="demo")
+    import glob
+    import tempfile
+
+    pattern = os.path.join(tempfile.gettempdir(), "osim-chart-*")
+    before = set(glob.glob(pattern))
+    docs = process_chart(tgz)
+    assert docs[0]["kind"] == "ConfigMap"
+    # extraction temp dirs are cleaned up
+    assert set(glob.glob(pattern)) == before
+
+
+def test_tgz_symlink_escape_rejected(tmp_path):
+    import io
+    import tarfile
+
+    tgz = os.path.join(tmp_path, "evil.tgz")
+    with tarfile.open(tgz, "w:gz") as tf:
+        link = tarfile.TarInfo("demo/sub")
+        link.type = tarfile.SYMTYPE
+        link.linkname = str(tmp_path / "victim")
+        tf.addfile(link)
+        data = b"kind: ConfigMap\n"
+        f = tarfile.TarInfo("demo/sub/x.yaml")
+        f.size = len(data)
+        tf.addfile(f, io.BytesIO(data))
+    with pytest.raises(ChartError):
+        process_chart(tgz)
+    assert not (tmp_path / "victim").exists()
+
+
+# ---------------------------------------------------------------------------
+# the reference's real chart
+# ---------------------------------------------------------------------------
+
+def test_renders_reference_yoda_chart():
+    path = "/root/reference/example/application/charts/yoda"
+    if not os.path.isdir(path):
+        pytest.skip("reference chart unavailable")
+    objs = process_chart(path, release_name="yoda")
+    kinds = [o["kind"] for o in objs]
+    assert kinds.count("StorageClass") == 5
+    assert "DaemonSet" in kinds and "CronJob" in kinds
+    # install order: every StorageClass before every Deployment
+    assert max(i for i, k in enumerate(kinds) if k == "StorageClass") < min(
+        i for i, k in enumerate(kinds) if k == "Deployment"
+    )
+    joined = yaml.safe_dump_all(objs)
+    assert "{{" not in joined
+    sc_names = {o["metadata"]["name"] for o in objs if o["kind"] == "StorageClass"}
+    assert "yoda-lvm-default" in sc_names
