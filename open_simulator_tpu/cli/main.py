@@ -36,8 +36,9 @@ def _add_apply(sub: argparse._SubParsersAction) -> None:
     )
     p.add_argument(
         "--use-greed", action="store_true",
-        help="accepted for CLI parity (the reference flag is not wired either, "
-        "pkg/algo/greed.go vs simulator.go:238-241)",
+        help="order pods by descending dominant resource share before "
+        "scheduling (GreedQueue; the reference declares this flag but never "
+        "wires it — here it works)",
     )
     p.add_argument(
         "--extended-resources", default="",
@@ -55,7 +56,11 @@ def main(argv=None) -> int:
     _add_apply(sub)
     ps = sub.add_parser("server", help="run the REST simulation service")
     ps.add_argument("--port", type=int, default=9998)
-    ps.add_argument("--kubeconfig", default="", help="accepted for parity; unused")
+    ps.add_argument(
+        "--kubeconfig", default="",
+        help="snapshot this cluster per request when the request body carries "
+        "no cluster spec",
+    )
     sub.add_parser("version", help="print version")
     pd = sub.add_parser("gen-doc", help="generate CLI markdown docs")
     pd.add_argument("--output-dir", default="./docs/commandline")
@@ -69,7 +74,7 @@ def main(argv=None) -> int:
     if args.command == "server":
         from ..server.server import serve
 
-        return serve(port=args.port)
+        return serve(port=args.port, kubeconfig=args.kubeconfig)
     if args.command == "apply":
         from ..api.config import SimonConfig
         from ..engine.apply import ApplyError, run_apply
@@ -84,6 +89,7 @@ def main(argv=None) -> int:
                     auto_plan=not args.no_auto_plan,
                     out=out,
                     scheduler_config=args.default_scheduler_config,
+                    use_greed=args.use_greed,
                 )
             finally:
                 if out is not None:

@@ -34,11 +34,20 @@ class ApplyError(Exception):
 
 def build_cluster(cfg: SimonConfig) -> ClusterResource:
     if cfg.kube_config:
-        raise ApplyError(
-            "spec.cluster.kubeConfig requires access to a live cluster, which "
-            "this environment does not provide; use spec.cluster.customConfig "
-            "with a directory of manifests (see example/)"
+        # Real-cluster snapshot (CreateClusterResourceFromClient,
+        # simulator.go:503-601) via the built-in REST client.
+        from ..utils.kubeclient import (
+            KubeClientError,
+            create_cluster_resource_from_kubeconfig,
         )
+
+        try:
+            cluster = create_cluster_resource_from_kubeconfig(cfg.kube_config)
+        except KubeClientError as e:
+            raise ApplyError(f"spec.cluster.kubeConfig: {e}")
+        if not cluster.nodes:
+            raise ApplyError("cluster snapshot returned no nodes")
+        return cluster
     objs = objects_from_directory(cfg.custom_config)
     cluster = ClusterResource.from_objects(objs)
     if not cluster.nodes:
@@ -121,6 +130,7 @@ def run_apply(
     out: Optional[TextIO] = None,
     input_fn=input,
     scheduler_config: str = "",
+    use_greed: bool = False,
 ) -> ApplyOutcome:
     import sys
 
@@ -132,13 +142,14 @@ def run_apply(
     new_node = load_new_node(cfg)
     weights = load_scheduler_config(scheduler_config).weights
 
-    result = simulate(cluster, apps, weights=weights)
+    result = simulate(cluster, apps, weights=weights, use_greed=use_greed)
     plan: Optional[CapacityPlan] = None
 
     if result.unscheduled and new_node is not None:
         if interactive:
             result = _interactive_loop(
-                cluster, apps, new_node, result, out, input_fn, weights=weights
+                cluster, apps, new_node, result, out, input_fn, weights=weights,
+                use_greed=use_greed,
             )
         elif auto_plan:
             print(
@@ -146,7 +157,9 @@ def run_apply(
                 f"minimum copies of node {new_node.name}...",
                 file=out,
             )
-            plan = plan_capacity(cluster, apps, new_node, weights=weights)
+            plan = plan_capacity(
+                cluster, apps, new_node, weights=weights, use_greed=use_greed
+            )
             if plan is None:
                 print("capacity search failed: workload does not fit", file=out)
             else:
@@ -170,6 +183,7 @@ def _interactive_loop(
     out: TextIO,
     input_fn,
     weights=None,
+    use_greed: bool = False,
 ) -> SimulateResult:
     """The reference's manual loop (apply.go:203-259): add one node / show
     reasons / exit, re-simulating from scratch each iteration."""
@@ -192,5 +206,5 @@ def _interactive_loop(
             daemonsets=list(cluster.daemonsets),
             others=dict(cluster.others),
         )
-        result = simulate(trial, apps, weights=weights)
+        result = simulate(trial, apps, weights=weights, use_greed=use_greed)
     return result

@@ -81,6 +81,7 @@ def _probe(
     template: Node,
     k: int,
     weights: Optional[dict],
+    use_greed: bool = False,
 ) -> SimulateResult:
     trial = ClusterResource(
         nodes=list(cluster.nodes) + new_fake_nodes(template, k),
@@ -88,7 +89,7 @@ def _probe(
         daemonsets=list(cluster.daemonsets),
         others=dict(cluster.others),
     )
-    return simulate(trial, apps, weights=weights)
+    return simulate(trial, apps, weights=weights, use_greed=use_greed)
 
 
 def plan_capacity(
@@ -97,6 +98,7 @@ def plan_capacity(
     new_node: Node,
     max_new_nodes: int = 1 << 14,
     weights: Optional[dict] = None,
+    use_greed: bool = False,
 ) -> Optional[CapacityPlan]:
     """Minimum clones of `new_node` so every pod schedules and utilization
     gates pass. Returns None if even max_new_nodes doesn't suffice."""
@@ -106,7 +108,7 @@ def plan_capacity(
     def good(res: SimulateResult) -> bool:
         return not res.unscheduled and satisfy_resource_setting(res)
 
-    base = _probe(cluster, apps, new_node, 0, weights)
+    base = _probe(cluster, apps, new_node, 0, weights, use_greed)
     attempts += 1
     if good(base):
         return CapacityPlan(0, base, attempts)
@@ -115,7 +117,7 @@ def plan_capacity(
     lo, hi = 0, 1
     hi_result = None
     while hi <= max_new_nodes:
-        hi_result = _probe(cluster, apps, new_node, hi, weights)
+        hi_result = _probe(cluster, apps, new_node, hi, weights, use_greed)
         attempts += 1
         if good(hi_result):
             break
@@ -126,7 +128,7 @@ def plan_capacity(
     best, best_result = hi, hi_result
     while lo + 1 < hi:
         mid = (lo + hi) // 2
-        res = _probe(cluster, apps, new_node, mid, weights)
+        res = _probe(cluster, apps, new_node, mid, weights, use_greed)
         attempts += 1
         if good(res):
             hi, best, best_result = mid, mid, res

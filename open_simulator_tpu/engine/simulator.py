@@ -11,9 +11,9 @@ Parity map (reference → here):
     a plain object with no background goroutines to defuse (SURVEY §3.4's
     leak-by-design is structurally impossible here).
 
-Pod ordering parity: ScheduleApp sorts by AffinityQueue then TolerationQueue
-(`simulator.go:238-241`, `pkg/algo/{affinity,toleration}.go`): pods with
-tolerations first, then pods with node selectors.
+Pod ordering parity: core/ordering.py (AffinityQueue then TolerationQueue,
+plus a working GreedQueue behind use_greed — `simulator.go:238-241`,
+`pkg/algo/`).
 """
 
 from __future__ import annotations
@@ -34,6 +34,7 @@ from ..core.objects import (
     NodeLocalStorage,
     Pod,
 )
+from ..core.ordering import order_pods
 from ..core.workloads import WORKLOAD_KINDS, pods_from_workload
 from ..ops.encode import (
     Encoder,
@@ -136,13 +137,6 @@ class SimulateResult:
         return []
 
 
-def _order_pods(pods: List[Pod]) -> List[Pod]:
-    """AffinityQueue then TolerationQueue, as stable sorts (algo.go parity)."""
-    pods = sorted(pods, key=lambda p: not p.node_selector)
-    pods = sorted(pods, key=lambda p: not p.tolerations)
-    return pods
-
-
 def _reason_string(n_nodes: int, counts: np.ndarray) -> str:
     """Rebuild the reference's unschedulable diagnostics, e.g.
     '0/4 nodes are available: 3 node(s) had taint..., 1 Insufficient resources.'
@@ -163,8 +157,10 @@ class Simulator:
         self,
         cluster: ClusterResource,
         weights: Optional[dict] = None,
+        use_greed: bool = False,
     ) -> None:
         self.cluster = cluster
+        self.use_greed = use_greed
         self.weights = weights_array(weights or DEFAULT_WEIGHTS)
         self.enc = Encoder(topology_keys=("kubernetes.io/hostname",))
         self._bound: List[Tuple[Pod, str]] = []   # (pod, node name)
@@ -364,6 +360,9 @@ class Simulator:
             free=free, sel_counts=sel, gpu_free=gpu, vg_free=vg, dev_free=dev
         )
 
+    def _order(self, pods: List[Pod]) -> List[Pod]:
+        return order_pods(pods, self.cluster.nodes, use_greed=self.use_greed)
+
     # -- public ------------------------------------------------------------
     def run(self, apps: Sequence[AppResource]) -> SimulateResult:
         app_pods: List[List[Pod]] = []
@@ -373,7 +372,7 @@ class Simulator:
                 kind = obj.get("kind", "")
                 if kind in WORKLOAD_KINDS:
                     pods.extend(pods_from_workload(obj, nodes=self.cluster.nodes))
-            app_pods.append(_order_pods(pods))
+            app_pods.append(self._order(pods))
 
         self._build_device_state(
             self._pending_cluster + [p for pods in app_pods for p in pods]
@@ -383,7 +382,7 @@ class Simulator:
         # RunCluster: the cluster's own pending pods schedule first.
         result.unscheduled.extend(
             self._try_preemptions(
-                self._schedule_batch_host(_order_pods(self._pending_cluster))
+                self._schedule_batch_host(self._order(self._pending_cluster))
             )
         )
         # ScheduleApp: each app in configured order.
@@ -441,6 +440,7 @@ def simulate(
     cluster: ClusterResource,
     apps: Sequence[AppResource],
     weights: Optional[dict] = None,
+    use_greed: bool = False,
 ) -> SimulateResult:
     """One-shot simulation (parity: simulator.Simulate, core.go:67-119)."""
-    return Simulator(cluster, weights=weights).run(apps)
+    return Simulator(cluster, weights=weights, use_greed=use_greed).run(apps)

@@ -6,12 +6,14 @@ Parity: `/root/reference/pkg/server/server.go` — gin routes
   GET  /healthz           liveness
 with the reference's TryLock busy-rejection (503 while a simulation runs).
 
-The reference snapshots a live cluster through informers; this environment has
-no cluster, so snapshots arrive in the request body (or from a manifest
-directory on disk) — the simulation semantics are identical. Request schema:
+The reference snapshots a live cluster through informers; here the snapshot
+comes from the request body, a manifest directory on disk, or — when the
+server was started with --kubeconfig — a fresh REST snapshot of the live
+cluster per request (CreateClusterResourceFromClient parity). Request schema:
 
   {
-    "cluster": {"objects": [...k8s objects...]} | {"path": "dir"},
+    "cluster": {"objects": [...k8s objects...]} | {"path": "dir"},  # optional
+                                     # with --kubeconfig
     "apps":    [{"name": "a", "objects": [...]}],
     "newNodes": [...Node objects...],            # optional
     "removeWorkloads": [{"kind": "Deployment", "name": "x", "namespace": "d"}]
@@ -35,15 +37,22 @@ from ..engine.simulator import AppResource, ClusterResource, simulate
 from ..utils.yamlio import objects_from_directory
 
 _busy = threading.Lock()
+_kubeconfig: Optional[str] = None  # set by serve()/make_server()
 
 
 def _simulate_request(body: dict) -> dict:
     cluster_spec = body.get("cluster") or {}
     if "path" in cluster_spec:
         objs = objects_from_directory(cluster_spec["path"])
+        cluster = ClusterResource.from_objects(objs)
+    elif cluster_spec.get("objects"):
+        cluster = ClusterResource.from_objects(list(cluster_spec["objects"]))
+    elif _kubeconfig:
+        from ..utils.kubeclient import create_cluster_resource_from_kubeconfig
+
+        cluster = create_cluster_resource_from_kubeconfig(_kubeconfig)
     else:
-        objs = list(cluster_spec.get("objects") or [])
-    cluster = ClusterResource.from_objects(objs)
+        cluster = ClusterResource.from_objects([])
     for nd in body.get("newNodes") or []:
         cluster.nodes.append(Node.from_dict(nd))
 
@@ -94,6 +103,14 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802
         if self.path == "/healthz":
             self._send(200, {"status": "ok"})
+        elif self.path == "/test":
+            # parity: GET /test returns the literal "test" (server.go:154-156)
+            data = b"test"
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
         else:
             self._send(404, {"error": "not found"})
 
@@ -121,7 +138,13 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
 
-def serve(port: int = 9998, ready: Optional[threading.Event] = None) -> int:
+def serve(
+    port: int = 9998,
+    ready: Optional[threading.Event] = None,
+    kubeconfig: str = "",
+) -> int:
+    global _kubeconfig
+    _kubeconfig = kubeconfig or None
     httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
     if ready is not None:
         ready.set()

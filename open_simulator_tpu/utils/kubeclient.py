@@ -1,0 +1,204 @@
+"""Minimal Kubernetes REST client + kubeconfig loader.
+
+Parity target: `CreateClusterResourceFromClient`
+(`/root/reference/pkg/simulator/simulator.go:503-601`) — snapshot a REAL
+cluster as the simulation's starting state: nodes; non-DaemonSet-owned,
+non-terminating Running pods then Pending pods; PDBs, Services,
+StorageClasses, PVCs, ConfigMaps, DaemonSets.
+
+The reference rides client-go; this is a dependency-free client over stdlib
+urllib/ssl understanding the common kubeconfig auth shapes: cluster CA data,
+client cert/key (inline *-data or file paths), and bearer tokens. Anything
+beyond that (exec plugins, OIDC refresh) raises KubeClientError with a clear
+message — this environment has no live cluster, so all paths are exercised by
+tests against a stub API server.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import ssl
+import tempfile
+import urllib.error
+import urllib.request
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+
+class KubeClientError(Exception):
+    pass
+
+
+@dataclass
+class KubeConfig:
+    server: str
+    ca_file: Optional[str] = None
+    cert_file: Optional[str] = None
+    key_file: Optional[str] = None
+    token: Optional[str] = None
+    insecure: bool = False
+
+
+def _materialize(data_b64: Optional[str], path: Optional[str], suffix: str) -> Optional[str]:
+    """Inline base64 *-data wins over the *-file path (kubectl precedence)."""
+    if data_b64:
+        fd, tmp = tempfile.mkstemp(prefix="osim-kube-", suffix=suffix)
+        with os.fdopen(fd, "wb") as fh:
+            fh.write(base64.b64decode(data_b64))
+        return tmp
+    return path
+
+
+def load_kubeconfig(path: str, context: Optional[str] = None) -> KubeConfig:
+    """Resolve the current (or named) context into connection settings."""
+    try:
+        with open(path) as fh:
+            doc = yaml.safe_load(fh) or {}
+    except OSError as e:
+        raise KubeClientError(f"cannot read kubeconfig {path}: {e}")
+
+    ctx_name = context or doc.get("current-context")
+    if not ctx_name:
+        raise KubeClientError(f"{path}: no current-context set")
+    ctxs = {c.get("name"): c.get("context") or {} for c in doc.get("contexts") or []}
+    if ctx_name not in ctxs:
+        raise KubeClientError(f"{path}: context {ctx_name!r} not found")
+    ctx = ctxs[ctx_name]
+
+    clusters = {c.get("name"): c.get("cluster") or {} for c in doc.get("clusters") or []}
+    users = {u.get("name"): u.get("user") or {} for u in doc.get("users") or []}
+    cluster = clusters.get(ctx.get("cluster"))
+    if cluster is None:
+        raise KubeClientError(f"{path}: cluster {ctx.get('cluster')!r} not found")
+    user = users.get(ctx.get("user"), {})
+
+    server = cluster.get("server")
+    if not server:
+        raise KubeClientError(f"{path}: cluster has no server URL")
+
+    token = user.get("token")
+    if not token and user.get("exec"):
+        raise KubeClientError(
+            f"{path}: exec credential plugins are not supported by the "
+            "built-in client; provide a token or client certificates"
+        )
+    return KubeConfig(
+        server=server.rstrip("/"),
+        ca_file=_materialize(
+            cluster.get("certificate-authority-data"),
+            cluster.get("certificate-authority"),
+            ".crt",
+        ),
+        cert_file=_materialize(
+            user.get("client-certificate-data"), user.get("client-certificate"), ".crt"
+        ),
+        key_file=_materialize(
+            user.get("client-key-data"), user.get("client-key"), ".key"
+        ),
+        token=token,
+        insecure=bool(cluster.get("insecure-skip-tls-verify")),
+    )
+
+
+class KubeClient:
+    """GET-only API client: list_* helpers returning decoded items."""
+
+    def __init__(self, cfg: KubeConfig, timeout: float = 30.0) -> None:
+        self.cfg = cfg
+        self.timeout = timeout
+        if cfg.server.startswith("https"):
+            if cfg.insecure:
+                ctx = ssl._create_unverified_context()
+            else:
+                ctx = ssl.create_default_context(cafile=cfg.ca_file)
+            if cfg.cert_file:
+                ctx.load_cert_chain(cfg.cert_file, cfg.key_file)
+            self._ssl = ctx
+        else:
+            self._ssl = None
+
+    @staticmethod
+    def from_kubeconfig(path: str, context: Optional[str] = None) -> "KubeClient":
+        return KubeClient(load_kubeconfig(path, context))
+
+    def get(self, api_path: str) -> Dict[str, Any]:
+        url = f"{self.cfg.server}{api_path}"
+        req = urllib.request.Request(url)
+        req.add_header("Accept", "application/json")
+        if self.cfg.token:
+            req.add_header("Authorization", f"Bearer {self.cfg.token}")
+        try:
+            with urllib.request.urlopen(
+                req, timeout=self.timeout, context=self._ssl
+            ) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            raise KubeClientError(f"GET {api_path}: HTTP {e.code} {e.reason}")
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            raise KubeClientError(f"GET {api_path}: {e}")
+
+    def list(self, api_path: str, kind: str) -> List[dict]:
+        """List a resource; items get apiVersion/kind stamped back on (the
+        API server omits them inside List responses)."""
+        doc = self.get(api_path)
+        items = doc.get("items") or []
+        parts = api_path.lstrip("/").split("/")
+        # /api/v1/...        -> "v1"
+        # /apis/<g>/<v>/...  -> "<g>/<v>"
+        api_version = parts[1] if parts[0] == "api" else f"{parts[1]}/{parts[2]}"
+        for item in items:
+            item.setdefault("apiVersion", api_version)
+            item.setdefault("kind", kind)
+        return items
+
+
+def _owned_by_daemonset(pod: dict) -> bool:
+    for ref in (pod.get("metadata") or {}).get("ownerReferences") or []:
+        if ref.get("kind") == "DaemonSet":
+            return True
+    return False
+
+
+def snapshot_cluster(client: KubeClient):
+    """CreateClusterResourceFromClient parity: the decoded objects forming the
+    simulation's initial state. Returns a ClusterResource."""
+    from ..engine.simulator import ClusterResource
+
+    objs: List[dict] = []
+    objs.extend(client.list("/api/v1/nodes", "Node"))
+
+    running: List[dict] = []
+    pending: List[dict] = []
+    for pod in client.list("/api/v1/pods?resourceVersion=0", "Pod"):
+        meta = pod.get("metadata") or {}
+        if _owned_by_daemonset(pod) or meta.get("deletionTimestamp"):
+            continue  # workload pods are regenerated; DS pods re-expand
+        phase = (pod.get("status") or {}).get("phase")
+        if phase == "Running":
+            running.append(pod)
+        elif phase == "Pending":
+            pending.append(pod)
+    objs.extend(running)
+    objs.extend(pending)  # pending after running (simulator.go:527-541)
+
+    objs.extend(
+        client.list(
+            "/apis/policy/v1beta1/poddisruptionbudgets", "PodDisruptionBudget"
+        )
+    )
+    objs.extend(client.list("/api/v1/services", "Service"))
+    objs.extend(client.list("/apis/storage.k8s.io/v1/storageclasses", "StorageClass"))
+    objs.extend(
+        client.list("/api/v1/persistentvolumeclaims", "PersistentVolumeClaim")
+    )
+    objs.extend(client.list("/api/v1/configmaps", "ConfigMap"))
+    objs.extend(client.list("/apis/apps/v1/daemonsets", "DaemonSet"))
+    return ClusterResource.from_objects(objs)
+
+
+def create_cluster_resource_from_kubeconfig(path: str, context: Optional[str] = None):
+    return snapshot_cluster(KubeClient.from_kubeconfig(path, context))

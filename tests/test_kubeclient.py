@@ -1,0 +1,227 @@
+"""Built-in Kubernetes REST client + cluster snapshotting.
+
+Parity: CreateClusterResourceFromClient (pkg/simulator/simulator.go:503-601):
+nodes; non-DaemonSet-owned, non-terminating Running pods then Pending pods;
+PDBs/Services/StorageClasses/PVCs/ConfigMaps/DaemonSets. Exercised against a
+stub API server (no live cluster in this environment).
+"""
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+import yaml
+
+from open_simulator_tpu.utils.kubeclient import (
+    KubeClient,
+    KubeClientError,
+    KubeConfig,
+    create_cluster_resource_from_kubeconfig,
+    load_kubeconfig,
+    snapshot_cluster,
+)
+
+
+def _node(name):
+    return {
+        "metadata": {"name": name},
+        "status": {"allocatable": {"cpu": "8", "memory": "16Gi", "pods": "110"}},
+    }
+
+
+def _pod(name, phase="Running", node="n1", owner_kind=None, deleting=False):
+    meta = {"name": name, "namespace": "default"}
+    if owner_kind:
+        meta["ownerReferences"] = [
+            {"kind": owner_kind, "name": "own", "controller": True}
+        ]
+    if deleting:
+        meta["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+    return {
+        "metadata": meta,
+        "spec": {
+            "nodeName": node if phase == "Running" else "",
+            "containers": [{"name": "c", "resources": {"requests": {"cpu": "1"}}}],
+        },
+        "status": {"phase": phase},
+    }
+
+
+APIS = {
+    "/api/v1/nodes": {"items": [_node("n1"), _node("n2")]},
+    "/api/v1/pods": {
+        "items": [
+            _pod("run-1"),
+            _pod("pend-1", phase="Pending"),
+            _pod("ds-pod", owner_kind="DaemonSet"),
+            _pod("dying", deleting=True),
+            _pod("done", phase="Succeeded"),
+        ]
+    },
+    "/apis/policy/v1beta1/poddisruptionbudgets": {
+        "items": [
+            {
+                "metadata": {"name": "pdb", "namespace": "default"},
+                "spec": {"minAvailable": 1, "selector": {"matchLabels": {"a": "b"}}},
+            }
+        ]
+    },
+    "/api/v1/services": {"items": []},
+    "/apis/storage.k8s.io/v1/storageclasses": {
+        "items": [{"metadata": {"name": "open-local-lvm"}}]
+    },
+    "/api/v1/persistentvolumeclaims": {"items": []},
+    "/api/v1/configmaps": {"items": []},
+    "/apis/apps/v1/daemonsets": {
+        "items": [
+            {
+                "metadata": {"name": "agent", "namespace": "kube-system"},
+                "spec": {
+                    "template": {
+                        "spec": {
+                            "containers": [
+                                {
+                                    "name": "c",
+                                    "resources": {"requests": {"cpu": "100m"}},
+                                }
+                            ]
+                        }
+                    }
+                },
+            }
+        ]
+    },
+}
+
+
+class _StubAPI(BaseHTTPRequestHandler):
+    auth_seen = []
+
+    def do_GET(self):  # noqa: N802
+        path = self.path.split("?")[0]
+        type(self).auth_seen.append(self.headers.get("Authorization"))
+        doc = APIS.get(path)
+        if doc is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        data = json.dumps(doc).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, fmt, *args):
+        pass
+
+
+@pytest.fixture()
+def stub_api():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _StubAPI)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def _write_kubeconfig(tmp_path, server, token="sekrit"):
+    doc = {
+        "apiVersion": "v1",
+        "kind": "Config",
+        "current-context": "ctx",
+        "contexts": [{"name": "ctx", "context": {"cluster": "c", "user": "u"}}],
+        "clusters": [{"name": "c", "cluster": {"server": server}}],
+        "users": [{"name": "u", "user": {"token": token}}],
+    }
+    p = tmp_path / "kubeconfig"
+    p.write_text(yaml.safe_dump(doc))
+    return str(p)
+
+
+def test_load_kubeconfig(tmp_path):
+    path = _write_kubeconfig(tmp_path, "https://example:6443")
+    cfg = load_kubeconfig(path)
+    assert cfg.server == "https://example:6443"
+    assert cfg.token == "sekrit"
+
+
+def test_load_kubeconfig_inline_ca(tmp_path):
+    doc = {
+        "current-context": "ctx",
+        "contexts": [{"name": "ctx", "context": {"cluster": "c", "user": "u"}}],
+        "clusters": [
+            {
+                "name": "c",
+                "cluster": {
+                    "server": "https://example",
+                    "certificate-authority-data": base64.b64encode(b"CERT").decode(),
+                },
+            }
+        ],
+        "users": [{"name": "u", "user": {"token": "t"}}],
+    }
+    p = tmp_path / "kc"
+    p.write_text(yaml.safe_dump(doc))
+    cfg = load_kubeconfig(str(p))
+    assert cfg.ca_file and open(cfg.ca_file, "rb").read() == b"CERT"
+
+
+def test_load_kubeconfig_errors(tmp_path):
+    with pytest.raises(KubeClientError):
+        load_kubeconfig(str(tmp_path / "missing"))
+    p = tmp_path / "empty"
+    p.write_text("{}")
+    with pytest.raises(KubeClientError):
+        load_kubeconfig(str(p))
+    # exec plugins unsupported, clearly
+    doc = {
+        "current-context": "ctx",
+        "contexts": [{"name": "ctx", "context": {"cluster": "c", "user": "u"}}],
+        "clusters": [{"name": "c", "cluster": {"server": "https://x"}}],
+        "users": [{"name": "u", "user": {"exec": {"command": "aws"}}}],
+    }
+    p2 = tmp_path / "exec"
+    p2.write_text(yaml.safe_dump(doc))
+    with pytest.raises(KubeClientError, match="exec"):
+        load_kubeconfig(str(p2))
+
+
+def test_snapshot_cluster(stub_api):
+    client = KubeClient(KubeConfig(server=stub_api, token="tok"))
+    cluster = snapshot_cluster(client)
+    assert [n.name for n in cluster.nodes] == ["n1", "n2"]
+    # DaemonSet-owned, terminating and Succeeded pods are dropped;
+    # Running comes before Pending
+    assert [p.meta.name for p in cluster.pods] == ["run-1", "pend-1"]
+    assert len(cluster.daemonsets) == 1
+    assert "PodDisruptionBudget" in cluster.others
+    assert "StorageClass" in cluster.others
+    # bearer token was sent
+    assert "Bearer tok" in _StubAPI.auth_seen
+
+
+def test_snapshot_via_kubeconfig_end_to_end(stub_api, tmp_path):
+    path = _write_kubeconfig(tmp_path, stub_api)
+    cluster = create_cluster_resource_from_kubeconfig(path)
+    assert len(cluster.nodes) == 2
+
+    # and it simulates: the pending pod reschedules, the DS re-expands
+    from open_simulator_tpu.engine.simulator import simulate
+
+    result = simulate(cluster, [])
+    assert not result.unscheduled
+    placed = {p.meta.name for st in result.node_status for p in st.pods}
+    assert "pend-1" in placed
+    # daemonset re-expanded onto both nodes
+    ds_pods = [p for p in placed if p.startswith("agent-")]
+    assert len(ds_pods) == 2
+
+
+def test_http_error_surfaces(stub_api):
+    client = KubeClient(KubeConfig(server=stub_api))
+    with pytest.raises(KubeClientError, match="404"):
+        client.get("/api/v1/nope")

@@ -1,0 +1,122 @@
+"""Pod-ordering queues (core/ordering.py).
+
+Parity: pkg/algo/{greed,affinity,toleration}.go — GreedQueue's dominant-share
+descending order with node-pinned pods first, AffinityQueue (nodeSelector
+first), TolerationQueue (tolerations first), and ScheduleApp's composition.
+"""
+
+from open_simulator_tpu.core.objects import Node, Pod
+from open_simulator_tpu.core.ordering import (
+    affinity_sort,
+    cluster_totals,
+    greed_sort,
+    order_pods,
+    pod_dominant_share,
+    share,
+    toleration_sort,
+)
+
+
+def mknode(name, cpu="10", mem="100Gi"):
+    return Node.from_dict(
+        {
+            "metadata": {"name": name},
+            "status": {"allocatable": {"cpu": cpu, "memory": mem, "pods": "110"}},
+        }
+    )
+
+
+def mkpod(name, cpu=None, mem=None, selector=None, tolerations=None, node=""):
+    req = {}
+    if cpu:
+        req["cpu"] = cpu
+    if mem:
+        req["memory"] = mem
+    spec = {"containers": [{"name": "c", "resources": {"requests": req}}]}
+    if selector:
+        spec["nodeSelector"] = selector
+    if tolerations:
+        spec["tolerations"] = tolerations
+    if node:
+        spec["nodeName"] = node
+    return Pod.from_dict({"metadata": {"name": name, "namespace": "d"}, "spec": spec})
+
+
+def names(pods):
+    return [p.meta.name for p in pods]
+
+
+def test_share():
+    assert share(0, 0) == 0.0
+    assert share(5, 0) == 1.0
+    assert share(5, 10) == 0.5
+
+
+def test_dominant_share_is_max_over_cpu_mem():
+    nodes = [mknode("n", cpu="10", mem="100Gi")]
+    totals = cluster_totals(nodes)
+    # 2/10 cpu vs 10/100 mem -> cpu dominates at 0.2
+    p = mkpod("p", cpu="2", mem="10Gi")
+    assert pod_dominant_share(p, totals) == 0.2
+    assert pod_dominant_share(mkpod("empty"), totals) == 0.0
+
+
+def test_greed_sort_descending_share_pinned_first():
+    nodes = [mknode("n")]
+    big = mkpod("big", cpu="5")
+    small = mkpod("small", cpu="1")
+    mid = mkpod("mid", cpu="3")
+    pinned = mkpod("pinned", cpu="1", node="n")
+    assert names(greed_sort([small, big, pinned, mid], nodes)) == [
+        "pinned", "big", "mid", "small",
+    ]
+
+
+def test_affinity_and_toleration_sorts():
+    sel = mkpod("sel", cpu="1", selector={"zone": "a"})
+    plain = mkpod("plain", cpu="1")
+    tol = mkpod("tol", cpu="1", tolerations=[{"key": "k", "operator": "Exists"}])
+    assert names(affinity_sort([plain, sel])) == ["sel", "plain"]
+    assert names(toleration_sort([plain, tol])) == ["tol", "plain"]
+
+
+def test_order_pods_composition():
+    nodes = [mknode("n")]
+    a = mkpod("big-tol", cpu="5", tolerations=[{"key": "k", "operator": "Exists"}])
+    b = mkpod("small-tol", cpu="1", tolerations=[{"key": "k", "operator": "Exists"}])
+    c = mkpod("big-plain", cpu="4")
+    d = mkpod("small-plain", cpu="2")
+    # default: toleration class first, stable within class
+    assert names(order_pods([c, a, d, b], nodes)) == [
+        "big-tol", "small-tol", "big-plain", "small-plain",
+    ]
+    # greed: share ordering within each toleration class
+    assert names(order_pods([b, d, a, c], nodes, use_greed=True)) == [
+        "big-tol", "small-tol", "big-plain", "small-plain",
+    ]
+
+
+def test_use_greed_end_to_end():
+    from open_simulator_tpu.engine.simulator import (
+        AppResource,
+        ClusterResource,
+        simulate,
+    )
+
+    cluster = ClusterResource(nodes=[mknode("w", cpu="8", mem="16Gi")])
+    dep = {
+        "kind": "Deployment",
+        "metadata": {"name": "web", "namespace": "d"},
+        "spec": {
+            "replicas": 3,
+            "template": {
+                "spec": {
+                    "containers": [
+                        {"name": "c", "resources": {"requests": {"cpu": "1"}}}
+                    ]
+                }
+            },
+        },
+    }
+    result = simulate(cluster, [AppResource("a", [dep])], use_greed=True)
+    assert not result.unscheduled
