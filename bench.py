@@ -17,12 +17,65 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
 TARGET_PODS_PER_SEC = 100_000 / 60.0  # driver north star
+
+
+def _probe_backend(platform: str, timeout_s: float) -> tuple[bool, str]:
+    """Check in a child process (bounded, killable) that `platform` can
+    actually initialize. The TPU tunnel ("axon") is known to hang during
+    backend init (round-1 BENCH was rc:1, MULTICHIP hung to rc:124), and a
+    hung in-process init cannot be interrupted — hence the subprocess."""
+    env = dict(os.environ)
+    if platform:
+        env["JAX_PLATFORMS"] = platform
+    else:
+        env.pop("JAX_PLATFORMS", None)
+    code = (
+        "import jax; d = jax.devices(); "
+        "import jax.numpy as jnp; jnp.zeros(8).block_until_ready(); "
+        "print(d[0].platform, len(d))"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            env=env, timeout=timeout_s, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"backend init timed out after {timeout_s:.0f}s"
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()
+        return False, (tail[-1] if tail else f"rc={r.returncode}")
+    return True, r.stdout.strip()
+
+
+def _select_backend(attempts: int = 2, timeout_s: float = 60.0) -> dict:
+    """Pick a working JAX platform before importing jax in this process.
+
+    Tries the environment's preset platform (the TPU tunnel) with bounded
+    retries; on failure falls back to CPU, clearly labeled in the output.
+    """
+    preset = os.environ.get("JAX_PLATFORMS", "")
+    info = {"requested_platform": preset or "(default)"}
+    last_err = ""
+    for attempt in range(attempts):
+        ok, msg = _probe_backend(preset, timeout_s)
+        if ok:
+            info["backend_probe"] = msg
+            return info
+        last_err = msg
+        if attempt + 1 < attempts:
+            time.sleep(2.0 * (attempt + 1))
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    info["fallback"] = "cpu"
+    info["fallback_reason"] = last_err
+    return info
 
 
 def build_state(n_nodes: int, n_pods: int):
@@ -148,7 +201,14 @@ def main() -> int:
     if args.quick:
         args.pods, args.nodes = 2_000, 200
 
+    backend_info = _select_backend()
+
     import jax
+
+    if backend_info.get("fallback") == "cpu":
+        from open_simulator_tpu.utils.platform import ensure_platform
+
+        ensure_platform()
 
     from open_simulator_tpu.ops.grouped import schedule_batch_grouped
     from open_simulator_tpu.ops.kernels import weights_array
@@ -184,6 +244,7 @@ def main() -> int:
         "nodes": args.nodes,
         "device": str(jax.devices()[0]),
     }
+    result.update(backend_info)
     print(json.dumps(result))
     return 0
 

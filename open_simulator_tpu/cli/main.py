@@ -66,6 +66,10 @@ def main(argv=None) -> int:
     pd.add_argument("--output-dir", default="./docs/commandline")
 
     args = parser.parse_args(argv)
+    if args.command in ("apply", "server"):
+        from ..utils.platform import ensure_platform
+
+        ensure_platform()
     if args.command == "version":
         print(f"simon-tpu version {VERSION}")
         return 0

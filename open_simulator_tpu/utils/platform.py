@@ -1,0 +1,25 @@
+"""Honor JAX_PLATFORMS in environments whose site hooks override it.
+
+This image's sitecustomize registers the single-chip TPU tunnel as the
+default platform *after* env processing, so `JAX_PLATFORMS=cpu simon apply
+...` would silently still target the TPU — and hang whenever the tunnel is
+down. jax.config.update is authoritative over the site hook, so entry points
+call ensure_platform() before any jax computation to restore the documented
+env-var semantics. (Same pattern as tests/conftest.py and the driver-facing
+__graft_entry__.dryrun_multichip.)
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def ensure_platform() -> None:
+    """If JAX_PLATFORMS is set in the environment, make it stick."""
+    plat = os.environ.get("JAX_PLATFORMS", "").strip()
+    if not plat:
+        return
+    import jax
+
+    if jax.config.jax_platforms != plat:
+        jax.config.update("jax_platforms", plat)
