@@ -150,7 +150,12 @@ def _free_after(node: Node, pods: Sequence[Pod]) -> Dict[str, int]:
 
 
 def _fits(pod: Pod, node: Node, remaining: Sequence[Pod]) -> bool:
-    return not fits_resources(pod, _free_after(node, remaining))
+    free = _free_after(node, remaining)
+    # pod.requests never carries the "pods" slot resource; check it explicitly
+    # (the reference's full-filter dry run gets this via NodeResourcesFit).
+    if free.get("pods", 0) < 1:
+        return False
+    return not fits_resources(pod, free)
 
 
 def _more_important(p: Pod) -> Tuple:
@@ -226,8 +231,13 @@ def pick_one_node(candidates: List[PreemptionResult]) -> Optional[PreemptionResu
         hi = min(max(v.priority for v in c.victims) for c in pool)
         pool = [c for c in pool if max(v.priority for v in c.victims) == hi]
     if len(pool) > 1:
-        s = min(sum(v.priority for v in c.victims) for c in pool)
-        pool = [c for c in pool if sum(v.priority for v in c.victims) == s]
+        # Offset each victim by MaxInt32+1 (default_preemption.go:497-503) so
+        # victim count dominates the sum even with negative priorities.
+        def psum(c):
+            return sum(v.priority + (1 << 31) for v in c.victims)
+
+        s = min(psum(c) for c in pool)
+        pool = [c for c in pool if psum(c) == s]
     if len(pool) > 1:
         n = min(len(c.victims) for c in pool)
         pool = [c for c in pool if len(c.victims) == n]

@@ -419,7 +419,14 @@ class Simulator:
                 LocalVG(
                     name=vg.name,
                     capacity=vg.capacity,
-                    requested=vg.capacity - int(vg_free[i, j]) * (1 << 20),
+                    requested=max(
+                        0,
+                        min(
+                            vg.capacity,
+                            vg.capacity
+                            - int(round(float(vg_free[i, j]))) * (1 << 20),
+                        ),
+                    ),
                 )
                 for j, vg in enumerate(st.vgs[: vg_free.shape[1]])
             ]

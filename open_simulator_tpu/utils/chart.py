@@ -78,13 +78,22 @@ def load_chart(path: str) -> Chart:
                     f"chart archive must contain one root dir, got {entries}"
                 )
             return _load_chart_dir(os.path.join(tmp, entries[0]))
-        except tarfile.TarError as e:
+        except (tarfile.TarError, OSError, UnicodeDecodeError, yaml.YAMLError) as e:
             raise ChartError(f"unreadable chart archive {path}: {e}")
+        except TypeError as e:
+            # tarfile's filter= kwarg is missing on old Python patch releases
+            if "filter" in str(e):
+                raise ChartError(f"tarfile filter unsupported: {e}")
+            raise
         finally:
             import shutil
 
             shutil.rmtree(tmp, ignore_errors=True)
-    return _load_chart_dir(path)
+    try:
+        return _load_chart_dir(path)
+    except (OSError, UnicodeDecodeError, yaml.YAMLError) as e:
+        # surface as ChartError so render_chart's helm-binary fallback engages
+        raise ChartError(f"unreadable chart {path}: {e}")
 
 
 def _load_chart_dir(path: str) -> Chart:
