@@ -99,6 +99,24 @@ def tolerations_tolerate_taint(tolerations: Iterable[Toleration], taint: Taint) 
     return any(toleration_tolerates(t, taint) for t in tolerations)
 
 
+_WILDCARD_IPS = ("", "0.0.0.0")
+
+
+def ports_conflict(
+    want: Iterable[tuple], used: Iterable[tuple]
+) -> bool:
+    """NodePorts conflict oracle (vendored node_ports.go Fits): two
+    (protocol, port, hostIP) entries clash iff protocol and port match and
+    either hostIP is the wildcard or they are equal."""
+    for wp, wport, wip in want:
+        for up, uport, uip in used:
+            if wp != up or wport != uport:
+                continue
+            if wip in _WILDCARD_IPS or uip in _WILDCARD_IPS or wip == uip:
+                return True
+    return False
+
+
 def untolerated_taint(pod_tolerations: List[Toleration], node: Node) -> Optional[Taint]:
     """First NoSchedule/NoExecute taint not tolerated (TaintToleration filter)."""
     for taint in node.taints:

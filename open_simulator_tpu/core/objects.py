@@ -473,7 +473,8 @@ class Pod:
     priority: int = 0
     preemption_policy: str = "PreemptLowerPriority"
     phase: str = "Pending"
-    host_ports: List[Tuple[str, int]] = field(default_factory=list)  # (protocol, port)
+    # (protocol, port, hostIP); hostIP "" or "0.0.0.0" = wildcard
+    host_ports: List[Tuple[str, int, str]] = field(default_factory=list)
     pvc_names: List[str] = field(default_factory=list)
     raw: dict = field(default_factory=dict)
 
@@ -484,7 +485,7 @@ class Pod:
         status = d.get("status") or {}
         # NodePorts filter parity: app containers only (vendored node_ports.go:64
         # iterates pod.Spec.Containers, not initContainers).
-        host_ports: List[Tuple[str, int]] = []
+        host_ports: List[Tuple[str, int, str]] = []
         host_network = bool(spec.get("hostNetwork"))
         for c in spec.get("containers") or []:
             for p in c.get("ports") or []:
@@ -492,7 +493,9 @@ class Pod:
                 cp = p.get("containerPort", 0)
                 port = hp or (cp if host_network else 0)
                 if port:
-                    host_ports.append((p.get("protocol", "TCP"), int(port)))
+                    host_ports.append(
+                        (p.get("protocol", "TCP"), int(port), p.get("hostIP", "") or "")
+                    )
         pvcs = [
             v["persistentVolumeClaim"]["claimName"]
             for v in (spec.get("volumes") or [])

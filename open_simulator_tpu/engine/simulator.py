@@ -42,6 +42,8 @@ from ..ops.encode import (
     aggregate_usage,
     encode_nodes,
     encode_pods,
+    initial_anti_counts,
+    initial_port_counts,
     initial_selector_counts,
 )
 from ..ops.grouped import schedule_batch_grouped
@@ -52,7 +54,7 @@ from ..ops.kernels import (
     weights_array,
 )
 from ..ops.state import (
-    align_sel_counts,
+    align_carry,
     carry_from_table,
     node_static_from_table,
 )
@@ -210,14 +212,18 @@ class Simulator:
         )
         self._ns = node_static_from_table(self.enc, self._table)
         sel = initial_selector_counts(self.enc, self._table, self._bound)
-        self._carry = carry_from_table(self._table, sel)
+        ports = initial_port_counts(self.enc, self._table, self._bound)
+        anti = initial_anti_counts(self.enc, self._table, self._bound)
+        self._carry = carry_from_table(
+            self._table, sel, port_counts=ports, anti_counts=anti
+        )
 
     def _schedule_batch_host(self, pods: List[Pod]) -> List[UnscheduledPod]:
         """Encode one batch, scan it on device, decode placements."""
         if not pods:
             return []
         batch = encode_pods(self.enc, pods)
-        self._carry = align_sel_counts(self._carry, len(self.enc.selectors))
+        self._carry, self._ns = align_carry(self._carry, self.enc, self._ns)
         # Grouped path: identical results to the naive scan, but static
         # filter/score work is hoisted per run of identical pods.
         (
@@ -332,6 +338,10 @@ class Simulator:
         gpu = np.asarray(self._carry.gpu_free).copy()
         vg = np.asarray(self._carry.vg_free).copy()
         dev = np.asarray(self._carry.dev_free).copy()
+        port_any = np.asarray(self._carry.port_any).copy()
+        port_wild = np.asarray(self._carry.port_wild).copy()
+        port_ipc = np.asarray(self._carry.port_ipc).copy()
+        anti = np.asarray(self._carry.anti_counts).copy()
         from ..ops.encode import resource_scale
 
         for v in victims:
@@ -352,12 +362,24 @@ class Simulator:
             if takes is not None:
                 vg[ni, : takes[0].shape[0]] += takes[0]
                 dev[ni, : takes[1].shape[0]] += takes[1]
+            for pid, wild, ipid in self.enc.port_ids(v):
+                if pid < port_any.shape[0]:
+                    port_any[pid, ni] -= 1.0
+                    if wild:
+                        port_wild[pid, ni] -= 1.0
+                if not wild and ipid < port_ipc.shape[0]:
+                    port_ipc[ipid, ni] -= 1.0
+            for aid in self.enc.anti_ids(v):
+                if aid < anti.shape[0]:
+                    anti[aid, ni] -= 1.0
             v.node_name = ""
             v.phase = "Pending"
             v.meta.annotations.pop(ANNO_GPU_INDEX, None)
             self._preempted.append(PreemptedPod(pod=v, node=node_name, by=by))
         self._carry = self._carry._replace(
-            free=free, sel_counts=sel, gpu_free=gpu, vg_free=vg, dev_free=dev
+            free=free, sel_counts=sel, gpu_free=gpu, vg_free=vg, dev_free=dev,
+            port_any=port_any, port_wild=port_wild, port_ipc=port_ipc,
+            anti_counts=anti,
         )
 
     def _order(self, pods: List[Pod]) -> List[Pod]:

@@ -139,6 +139,16 @@ class Encoder:
         self._selector_ids: Dict[Tuple, int] = {}
         self.domains = Vocab()     # "topokey=value" domain ids
         self.domain_topo: List[int] = []  # topo-key index per domain id (1-based)
+        # NodePorts: (protocol, port) ids and specific (protocol, port, ip) ids.
+        # Id 0 is the pad row of the count tables (never incremented).
+        self.ports = Vocab()
+        self.port_ips = Vocab()
+        # InterPodAffinity symmetry: registry of distinct required
+        # anti-affinity (topo key idx, selector id) terms across ALL pods, so
+        # existing pods' anti-affinity can repel matching incomers (the
+        # vendored plugin's existingAntiAffinityCounts).
+        self.anti_terms: List[Tuple[int, int]] = []
+        self._anti_ids: Dict[Tuple[int, int], int] = {}
 
     def domain_id(self, key_idx: int, key: str, value: str) -> int:
         before = len(self.domains)
@@ -175,6 +185,38 @@ class Encoder:
         self.vals.id(value)
         return self.pairs.id(f"{key}={value}")
 
+    def anti_term_id(self, topo_idx: int, sel_id: int) -> int:
+        key = (topo_idx, sel_id)
+        aid = self._anti_ids.get(key)
+        if aid is None:
+            aid = len(self.anti_terms)
+            self._anti_ids[key] = aid
+            self.anti_terms.append(key)
+        return aid
+
+    def port_ids(self, pod: Pod) -> List[Tuple[int, bool, int]]:
+        """(pid, is_wildcard_ip, ipid) per host port; registers vocab entries."""
+        from ..core.matcher import _WILDCARD_IPS
+
+        out = []
+        for proto, port, ip in pod.host_ports:
+            pid = self.ports.id(f"{proto}:{port}")
+            wild = ip in _WILDCARD_IPS
+            ipid = 0 if wild else self.port_ips.id(f"{proto}:{port}:{ip}")
+            out.append((pid, wild, ipid))
+        return out
+
+    def anti_ids(self, pod: Pod) -> List[int]:
+        """Required anti-affinity term ids this pod carries; registers them."""
+        out = []
+        for t in pod.affinity.anti_required:
+            if not t.topology_key:
+                continue
+            k = self.topo_index(t.topology_key)
+            s = self.selector_id(t.namespaces or (pod.meta.namespace,), t.selector)
+            out.append(self.anti_term_id(k, s))
+        return out
+
     def register_pods(self, pods: Sequence[Pod]) -> None:
         """Pre-register every resource name, topology key and selector used by
         a pod batch, so caps and ids are stable before arrays are built."""
@@ -196,6 +238,8 @@ class Encoder:
                 if t.topology_key:
                     self.topo_index(t.topology_key)
                 self.selector_id(t.namespaces or (pod.meta.namespace,), t.selector)
+            self.anti_ids(pod)
+            self.port_ids(pod)
 
 
 @dataclass
@@ -282,6 +326,14 @@ class PodBatch:
     # membership of this pod in each deduped selector
     match_sel: np.ndarray      # bool[P,S]
     owned_by_rs: np.ndarray    # bool[P] controller is ReplicaSet/RC (NodePreferAvoidPods)
+    # NodePorts: requested host ports (pid indexes the port_any/port_wild count
+    # tables; ipid indexes port_ipc; 0 = pad)
+    hp_pid: np.ndarray         # i32[P,HP]
+    hp_wild: np.ndarray        # bool[P,HP] hostIP is wildcard
+    hp_ipid: np.ndarray        # i32[P,HP]
+    # InterPodAffinity symmetry: per registered required-anti-affinity term
+    match_anti: np.ndarray     # bool[P,AT] pod matches term's selector+namespaces
+    own_anti: np.ndarray       # f32[P,AT] times this pod carries the term
     valid: np.ndarray          # bool[P]
     keys: List[str] = field(default_factory=list)  # namespace/name per row
 
@@ -485,6 +537,8 @@ def encode_pods(
     )
     vols = [pd.local_volumes() for pd in pods]
     SV = round_up(max((max(len(l), len(d)) for l, d in vols), default=1), 2)
+    HP = round_up(cap(lambda pd: len(pd.host_ports)), 2)
+    AT = max(len(enc.anti_terms), 1)
 
     b = PodBatch(
         req=np.zeros((P, R), np.float32),
@@ -524,6 +578,11 @@ def encode_pods(
         has_local=np.zeros(P, bool),
         match_sel=np.zeros((P, S), bool),
         owned_by_rs=np.zeros(P, bool),
+        hp_pid=np.zeros((P, HP), np.int32),
+        hp_wild=np.zeros((P, HP), bool),
+        hp_ipid=np.zeros((P, HP), np.int32),
+        match_anti=np.zeros((P, AT), bool),
+        own_anti=np.zeros((P, AT), np.float32),
         valid=np.zeros(P, bool),
         keys=[pd.key for pd in pods],
     )
@@ -578,6 +637,14 @@ def encode_pods(
             b.aff_weight[i, j] = weight
         for s, entry in enumerate(enc.selectors):
             b.match_sel[i, s] = entry.matches(pod)
+        for j, (pid, wild, ipid) in enumerate(enc.port_ids(pod)[:HP]):
+            b.hp_pid[i, j] = pid
+            b.hp_wild[i, j] = wild
+            b.hp_ipid[i, j] = ipid
+        for t, (k_idx, sel_id) in enumerate(enc.anti_terms):
+            b.match_anti[i, t] = enc.selectors[sel_id].matches(pod)
+        for aid in enc.anti_ids(pod):
+            b.own_anti[i, aid] += 1.0
         lvm_vols, dev_vols = vols[i]
         b.has_local[i] = bool(lvm_vols or dev_vols)
         # Explicit-VG volumes are allocated before binpack volumes, each class
@@ -668,6 +735,60 @@ def aggregate_usage(placed: Sequence[Tuple[Pod, str]]) -> Dict[str, Dict[str, in
             tot[res] = tot.get(res, 0) + q
         tot["pods"] = tot.get("pods", 0) + 1
     return usage
+
+
+def port_table_sizes(enc: Encoder) -> Tuple[int, int]:
+    """(PID, PIP) axis sizes for the port count tables. Row 0 is the pad row
+    (vocab ids are 1-based), so sizes are len+1 rounded for bucket stability."""
+    return round_up(len(enc.ports) + 1, 2), round_up(len(enc.port_ips) + 1, 2)
+
+
+def initial_port_counts(
+    enc: Encoder,
+    table: NodeTable,
+    placed: Sequence[Tuple[Pod, str]],
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(port_any f32[PID,N], port_wild f32[PID,N], port_ipc f32[PIP,N]):
+    host-port usage counts of already-bound pods, per node. port_any counts
+    every use of a (protocol, port) pair; port_wild only wildcard-hostIP uses;
+    port_ipc counts per specific (protocol, port, hostIP) triple."""
+    PID, PIP = port_table_sizes(enc)
+    port_any = np.zeros((PID, table.n), np.float32)
+    port_wild = np.zeros((PID, table.n), np.float32)
+    port_ipc = np.zeros((PIP, table.n), np.float32)
+    node_index = {name: i for i, name in enumerate(table.names)}
+    for pod, node_name in placed:
+        ni = node_index.get(node_name)
+        if ni is None or not pod.host_ports:
+            continue
+        for pid, wild, ipid in enc.port_ids(pod):
+            if pid < PID:
+                port_any[pid, ni] += 1.0
+                if wild:
+                    port_wild[pid, ni] += 1.0
+            if not wild and ipid < PIP:
+                port_ipc[ipid, ni] += 1.0
+    return port_any, port_wild, port_ipc
+
+
+def initial_anti_counts(
+    enc: Encoder,
+    table: NodeTable,
+    placed: Sequence[Tuple[Pod, str]],
+) -> np.ndarray:
+    """anti_counts f32[AT,N]: per (required-anti-affinity term, node) count of
+    already-placed pods carrying the term. Bound pods' terms must have been
+    registered (register_pods) before this is called."""
+    AT = max(len(enc.anti_terms), 1)
+    counts = np.zeros((AT, table.n), np.float32)
+    node_index = {name: i for i, name in enumerate(table.names)}
+    for pod, node_name in placed:
+        ni = node_index.get(node_name)
+        if ni is None:
+            continue
+        for aid in enc.anti_ids(pod):
+            counts[aid, ni] += 1.0
+    return counts
 
 
 def initial_selector_counts(

@@ -34,6 +34,7 @@ from .kernels import (
     F_GPU,
     F_NODE_AFFINITY,
     F_NODE_NAME,
+    F_NODE_PORTS,
     F_POD_AFFINITY,
     F_RESOURCES,
     F_SPREAD,
@@ -52,6 +53,8 @@ from .kernels import (
     local_storage_eval,
     node_affinity_mask,
     pod_affinity_mask,
+    ports_commit,
+    ports_mask,
     resource_fail,
     score_balanced,
     score_gpu_share,
@@ -76,12 +79,13 @@ def _static_parts(ns: NodeStatic, pod: PodRow, weights: jnp.ndarray):
         & (pod.tol_exists | (pod.tol_val == ns.empty_val_id))
         & ((pod.tol_effect == 0) | (pod.tol_effect == 1)),
     )
+    na_ok = node_affinity_mask(ns, pod)
     static_fails = jnp.stack(
         [
             ns.unsched & ~unsched_tolerated,
             (pod.node_name_id != 0) & (ns.name_id != pod.node_name_id),
             ~taint_mask(ns, pod),
-            ~node_affinity_mask(ns, pod),
+            ~na_ok,
         ],
         axis=1,
     )                                                   # [N,4]
@@ -97,7 +101,7 @@ def _static_parts(ns: NodeStatic, pod: PodRow, weights: jnp.ndarray):
         "prefer_avoid_pods": score_prefer_avoid(ns, pod),
         "simon": score_simon(ns, None, pod),
     }
-    return static_ok, static_first_fail, static_scores
+    return static_ok, static_first_fail, static_scores, na_ok
 
 
 def schedule_group(
@@ -111,20 +115,21 @@ def schedule_group(
     """Schedule `group_size` copies of one pod spec; only the first
     `valid_count` steps commit. Returns (carry, nodes i32[G], reasons i32[G,F]).
     """
-    static_ok, static_ff, static_scores = _static_parts(ns, pod, weights)
+    static_ok, static_ff, static_scores, na_ok = _static_parts(ns, pod, weights)
 
     def step(c: Carry, i):
         active = i < valid_count
+        port_ok = ports_mask(c, pod)
         res_fail = resource_fail(ns, c, pod)
-        spread_ok = spread_mask(ns, c, pod)
+        spread_ok = spread_mask(ns, c, pod, na_ok)
         aff_ok = pod_affinity_mask(ns, c, pod)
         # takes are re-derived inside local_storage_commit below; XLA CSE
         # collapses the two local_storage_eval calls within one jit
         storage_ok, _, _, storage_raw = local_storage_eval(ns, c, pod)
         gpu_ok = gpu_mask(ns, c, pod)
         mask = (
-            static_ok & ~res_fail & spread_ok & aff_ok & storage_ok & gpu_ok
-            & ns.valid
+            static_ok & port_ok & ~res_fail & spread_ok & aff_ok & storage_ok
+            & gpu_ok & ns.valid
         )
 
         # Stack in WEIGHT_ORDER exactly like run_scores so the f32 summation
@@ -132,7 +137,7 @@ def schedule_group(
         by_name = {
             "balanced_allocation": score_balanced(ns, c, pod),
             "least_allocated": score_least_allocated(ns, c, pod),
-            "topology_spread": score_topology_spread(ns, c, pod),
+            "topology_spread": score_topology_spread(ns, c, pod, na_ok),
             "inter_pod_affinity": score_inter_pod_affinity(ns, c, pod),
             "gpu_share": score_gpu_share(ns, c, pod),
             "open_local": jnp.where(
@@ -157,23 +162,31 @@ def schedule_group(
         vg_free, dev_free, vg_take_sel, dev_take_sel = local_storage_commit(
             ns, c, pod, onehot
         )
+        port_any, port_wild, port_ipc = ports_commit(c, pod, onehot)
+        anti_counts = c.anti_counts + (
+            pod.own_anti[:, None] * onehot.astype(jnp.float32)[None, :]
+        )
 
         first_fail = jnp.where(
             static_ff < NUM_FILTERS,
             static_ff,
             jnp.where(
-                res_fail,
-                F_RESOURCES,
+                ~port_ok,
+                F_NODE_PORTS,
                 jnp.where(
-                    ~spread_ok,
-                    F_SPREAD,
+                    res_fail,
+                    F_RESOURCES,
                     jnp.where(
-                        ~aff_ok,
-                        F_POD_AFFINITY,
+                        ~spread_ok,
+                        F_SPREAD,
                         jnp.where(
-                            ~storage_ok,
-                            F_STORAGE,
-                            jnp.where(~gpu_ok, F_GPU, NUM_FILTERS),
+                            ~aff_ok,
+                            F_POD_AFFINITY,
+                            jnp.where(
+                                ~storage_ok,
+                                F_STORAGE,
+                                jnp.where(~gpu_ok, F_GPU, NUM_FILTERS),
+                            ),
                         ),
                     ),
                 ),
@@ -187,6 +200,8 @@ def schedule_group(
         return Carry(
             free=free, sel_counts=sel_counts, gpu_free=gpu_free,
             vg_free=vg_free, dev_free=dev_free,
+            port_any=port_any, port_wild=port_wild, port_ipc=port_ipc,
+            anti_counts=anti_counts,
         ), (
             node_out.astype(jnp.int32),
             reason_counts,

@@ -44,18 +44,20 @@ F_UNSCHEDULABLE = 0
 F_NODE_NAME = 1
 F_TAINT = 2
 F_NODE_AFFINITY = 3
-F_RESOURCES = 4
-F_SPREAD = 5
-F_POD_AFFINITY = 6
-F_STORAGE = 7
-F_GPU = 8
-NUM_FILTERS = 9
+F_NODE_PORTS = 4
+F_RESOURCES = 5
+F_SPREAD = 6
+F_POD_AFFINITY = 7
+F_STORAGE = 8
+F_GPU = 9
+NUM_FILTERS = 10
 
 FILTER_MESSAGES = (
     "node(s) were unschedulable",
     "node(s) didn't match the requested node name",
     "node(s) had taint that the pod didn't tolerate",
     "node(s) didn't match Pod's node affinity/selector",
+    "node(s) didn't have free ports for the requested pod ports",
     "Insufficient resources",
     "node(s) didn't match pod topology spread constraints",
     "node(s) didn't match pod affinity/anti-affinity rules",
@@ -108,6 +110,8 @@ class NodeStatic(NamedTuple):
     topo_onehot: jnp.ndarray  # f32[K,D,N] domain membership (0 for missing key)
     unsched_key_id: jnp.ndarray  # i32 scalar: key id of node.kubernetes.io/unschedulable
     empty_val_id: jnp.ndarray    # i32 scalar: value id of ""
+    anti_topo: jnp.ndarray    # i32[AT] topo-key index per registered required
+                              # anti-affinity term (-1 pad) — IPA symmetry
 
 
 class Carry(NamedTuple):
@@ -119,6 +123,11 @@ class Carry(NamedTuple):
                              # reference's SchedulerCache)
     vg_free: jnp.ndarray     # f32[N,V] VG capacity - requested, MiB
     dev_free: jnp.ndarray    # f32[N,DV] 1.0 = device free, 0.0 = allocated
+    port_any: jnp.ndarray    # f32[PID,N] host-port uses per (proto,port)
+    port_wild: jnp.ndarray   # f32[PID,N] ... with wildcard hostIP only
+    port_ipc: jnp.ndarray    # f32[PIP,N] uses per specific (proto,port,ip)
+    anti_counts: jnp.ndarray  # f32[AT,N] placed pods carrying each
+                              # required-anti-affinity term (IPA symmetry)
 
 
 class PodRow(NamedTuple):
@@ -160,6 +169,11 @@ class PodRow(NamedTuple):
     has_local: jnp.ndarray
     match_sel: jnp.ndarray
     owned_by_rs: jnp.ndarray
+    hp_pid: jnp.ndarray
+    hp_wild: jnp.ndarray
+    hp_ipid: jnp.ndarray
+    match_anti: jnp.ndarray
+    own_anti: jnp.ndarray
     valid: jnp.ndarray
 
 
@@ -235,7 +249,12 @@ def taint_mask(ns: NodeStatic, pod: PodRow) -> jnp.ndarray:
 HOSTNAME_KEY_IDX = 0  # Encoder pins kubernetes.io/hostname at topo index 0
 
 
-def _domain_counts(ns: NodeStatic, counts_node: jnp.ndarray, k: jnp.ndarray):
+def _domain_counts(
+    ns: NodeStatic,
+    counts_node: jnp.ndarray,
+    k: jnp.ndarray,
+    elig: jnp.ndarray = None,
+):
     """Per-domain sums + their per-node broadcast for topology key k.
 
     Two representations (TPU scatters serialize, so neither path scatters):
@@ -246,10 +265,16 @@ def _domain_counts(ns: NodeStatic, counts_node: jnp.ndarray, k: jnp.ndarray):
         one-hot membership (f32-exact precision — bf16 MXU rounding would
         corrupt integer counts above 256), then an exact gather back to nodes.
 
+    `elig` bool[N] restricts which nodes participate (PodTopologySpread counts
+    and min only consider nodes passing the pod's node affinity/selector —
+    vendored podtopologyspread/common.go calPreFilterState skips other nodes);
+    None means all valid nodes.
+
     Returns (dom f32[D] — hostname slot returns zeros, use the host outputs —,
     cnt_n f32[N], min_count f32, total f32) where min_count is the minimum
-    count over existing domains of key k and total the sum over them."""
-    counts = jnp.where(ns.valid, counts_node, 0.0)
+    count over eligible domains of key k and total the sum over them."""
+    elig = ns.valid if elig is None else (elig & ns.valid)
+    counts = jnp.where(elig, counts_node, 0.0)
     is_host = k == HOSTNAME_KEY_IDX
 
     onehot = ns.topo_onehot[k]                                  # [D,N]
@@ -257,6 +282,10 @@ def _domain_counts(ns: NodeStatic, counts_node: jnp.ndarray, k: jnp.ndarray):
         onehot, counts, (((1,), (0,)), ((), ())),
         precision=jax.lax.Precision.HIGHEST,
     )                                                           # [D]
+    dom_elig = jax.lax.dot_general(
+        onehot, elig.astype(jnp.float32), (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+    ) > 0.0                                                     # [D]
     topo_k = ns.topo[:, k]
     D = dom.shape[0]
     cnt_gather = jnp.where(
@@ -264,9 +293,9 @@ def _domain_counts(ns: NodeStatic, counts_node: jnp.ndarray, k: jnp.ndarray):
     )
     cnt_n = jnp.where(is_host, counts, cnt_gather)
 
-    in_key = ns.domain_key == k                                 # [D]
+    in_key = (ns.domain_key == k) & dom_elig                    # [D]
     min_dom = jnp.min(jnp.where(in_key, dom, jnp.inf))
-    min_host = jnp.min(jnp.where(ns.valid, counts_node, jnp.inf))
+    min_host = jnp.min(jnp.where(elig, counts_node, jnp.inf))
     min_count = jnp.where(is_host, min_host, min_dom)
     min_count = jnp.where(jnp.isfinite(min_count), min_count, 0.0)
 
@@ -274,19 +303,25 @@ def _domain_counts(ns: NodeStatic, counts_node: jnp.ndarray, k: jnp.ndarray):
     return dom, cnt_n, min_count, total
 
 
-def spread_mask(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
+def spread_mask(
+    ns: NodeStatic, carry: Carry, pod: PodRow, na_ok: jnp.ndarray = None
+) -> jnp.ndarray:
     """PodTopologySpread hard constraints.
 
-    skew(node) = count(domain(node)) + 1 - min over existing domains of the
-    topology key. Deviation from upstream: the global min is taken over all
-    domains of the key rather than only node-affinity-eligible ones.
-    """
+    skew(node) = count(domain(node)) + 1 - min over eligible domains of the
+    topology key, where eligibility (`na_ok`, defaults to recomputing the
+    pod's node affinity/selector) restricts both the counts and the min —
+    matching calPreFilterState, which skips nodes failing the pod's
+    nodeSelector/required node affinity entirely."""
+    elig = node_affinity_mask(ns, pod) if na_ok is None else na_ok
 
     def one(topo_idx, sel_idx, max_skew, hard):
         active = (topo_idx >= 0) & hard
         k = jnp.maximum(topo_idx, 0)
         has_key = ns.topo[:, k] >= 0                            # [N]
-        _, cnt_n, min_count, _ = _domain_counts(ns, carry.sel_counts[sel_idx], k)
+        _, cnt_n, min_count, _ = _domain_counts(
+            ns, carry.sel_counts[sel_idx], k, elig
+        )
         ok = (cnt_n + 1.0 - min_count) <= max_skew + _EPS
         ok = ok & has_key
         return jnp.where(active, ok, jnp.ones_like(ok))
@@ -304,8 +339,10 @@ def pod_affinity_mask(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
     incoming pod matches its own selector and no match exists anywhere (the
     upstream first-pod-of-a-group special case).
     anti-affinity: candidate node's domain must hold none.
-    Deviation: existing pods' anti-affinity terms (symmetry check) are not yet
-    enforced — tracked for a later round.
+    symmetry: existing pods' required anti-affinity repels matching incomers —
+    for every registered anti term (ns.anti_topo) the pod's labels match
+    (pod.match_anti), domains already holding a carrier (carry.anti_counts)
+    are infeasible (the vendored plugin's existingAntiAffinityCounts).
     """
 
     def one(topo_idx, sel_idx, anti, required):
@@ -323,7 +360,19 @@ def pod_affinity_mask(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
     per_a = jax.vmap(one, in_axes=(0, 0, 0, 0), out_axes=1)(
         pod.aff_topo, pod.aff_sel, pod.aff_anti, pod.aff_required
     )
-    return jnp.all(per_a, axis=1)
+
+    def one_sym(topo_idx, cnt_row, match):
+        active = (topo_idx >= 0) & match
+        k = jnp.maximum(topo_idx, 0)
+        has_key = ns.topo[:, k] >= 0
+        _, cnt, _, _ = _domain_counts(ns, cnt_row, k)
+        ok = (cnt == 0) | ~has_key
+        return jnp.where(active, ok, jnp.ones(ns.valid.shape, bool))
+
+    per_sym = jax.vmap(one_sym, in_axes=(0, 0, 0), out_axes=1)(
+        ns.anti_topo, carry.anti_counts, pod.match_anti
+    )                                                           # [N,AT]
+    return jnp.all(per_a, axis=1) & jnp.all(per_sym, axis=1)
 
 
 # ---------------------------------------------------------------------------
@@ -555,6 +604,49 @@ def local_storage_commit(
     )
 
 
+def ports_mask(carry: Carry, pod: PodRow) -> jnp.ndarray:
+    """NodePorts filter (vendored plugins/nodeports): a requested host port
+    conflicts on a node iff the same (protocol, port) is already used there
+    with an overlapping hostIP — wildcard overlaps everything, specific IPs
+    only themselves. Row 0 of the count tables is the pad row (all zeros), so
+    padded hp slots are harmless; the explicit pid>0 guard keeps them inert
+    even after carry updates."""
+    any_tbl = carry.port_any[pod.hp_pid]                       # [HP,N]
+    wild_tbl = carry.port_wild[pod.hp_pid]                     # [HP,N]
+    ip_tbl = carry.port_ipc[pod.hp_ipid]                       # [HP,N]
+    conf_wild = any_tbl > 0.0
+    conf_spec = (wild_tbl > 0.0) | (ip_tbl > 0.0)
+    conf = jnp.where(pod.hp_wild[:, None], conf_wild, conf_spec)
+    conf = conf & (pod.hp_pid > 0)[:, None]
+    return ~jnp.any(conf, axis=0)
+
+
+def ports_commit(carry: Carry, pod: PodRow, onehot: jnp.ndarray):
+    """Record the committed pod's host ports into the selected node's counts.
+    Returns (port_any, port_wild, port_ipc). The HP-sized scatters serialize
+    on device but HP is tiny (max ports per pod)."""
+    sel = onehot.astype(jnp.float32)                           # [N]
+    active = (pod.hp_pid > 0).astype(jnp.float32)              # [HP]
+    add_any = jnp.zeros(carry.port_any.shape[0], jnp.float32).at[pod.hp_pid].add(
+        active, mode="drop"
+    )
+    add_wild = jnp.zeros(carry.port_wild.shape[0], jnp.float32).at[pod.hp_pid].add(
+        active * pod.hp_wild.astype(jnp.float32), mode="drop"
+    )
+    add_ipc = jnp.zeros(carry.port_ipc.shape[0], jnp.float32).at[pod.hp_ipid].add(
+        active * (~pod.hp_wild).astype(jnp.float32) * (pod.hp_ipid > 0), mode="drop"
+    )
+    # never count into the pad row — keep row 0 identically zero
+    add_any = add_any.at[0].set(0.0)
+    add_wild = add_wild.at[0].set(0.0)
+    add_ipc = add_ipc.at[0].set(0.0)
+    return (
+        carry.port_any + add_any[:, None] * sel[None, :],
+        carry.port_wild + add_wild[:, None] * sel[None, :],
+        carry.port_ipc + add_ipc[:, None] * sel[None, :],
+    )
+
+
 def resource_fail(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
     """NodeResourcesFit failure -> bool[N]. The whole-GPU extended resource
     (alibabacloud.com/gpu-count) is checked against its DYNAMIC allocatable —
@@ -583,14 +675,16 @@ def run_filters(ns: NodeStatic, carry: Carry, pod: PodRow):
         & (pod.tol_exists | (pod.tol_val == ns.empty_val_id))
         & ((pod.tol_effect == 0) | (pod.tol_effect == 1)),
     )
+    na_ok = node_affinity_mask(ns, pod)
     fails = jnp.stack(
         [
             ns.unsched & ~unsched_tolerated,
             (pod.node_name_id != 0) & (ns.name_id != pod.node_name_id),
             ~taint_mask(ns, pod),
-            ~node_affinity_mask(ns, pod),
+            ~na_ok,
+            ~ports_mask(carry, pod),
             resource_fail(ns, carry, pod),
-            ~spread_mask(ns, carry, pod),
+            ~spread_mask(ns, carry, pod, na_ok),
             ~pod_affinity_mask(ns, carry, pod),
             ~local_storage_mask(ns, carry, pod),
             ~gpu_mask(ns, carry, pod),
@@ -684,14 +778,19 @@ def score_prefer_avoid(ns: NodeStatic, pod: PodRow) -> jnp.ndarray:
     return jnp.where(avoided, 0.0, 100.0)
 
 
-def score_topology_spread(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
+def score_topology_spread(
+    ns: NodeStatic, carry: Carry, pod: PodRow, na_ok: jnp.ndarray = None
+) -> jnp.ndarray:
     """PodTopologySpread soft constraints: lower matching-count domains score
-    higher (reverse-normalized sum over ScheduleAnyway constraints)."""
+    higher (reverse-normalized sum over ScheduleAnyway constraints). Counting
+    only spans nodes passing the pod's node affinity/selector, like the
+    upstream PreScore (scoring.go:146-149)."""
+    elig = node_affinity_mask(ns, pod) if na_ok is None else na_ok
 
     def one(topo_idx, sel_idx, hard):
         active = (topo_idx >= 0) & ~hard
         k = jnp.maximum(topo_idx, 0)
-        _, cnt, _, _ = _domain_counts(ns, carry.sel_counts[sel_idx], k)
+        _, cnt, _, _ = _domain_counts(ns, carry.sel_counts[sel_idx], k, elig)
         return jnp.where(active, cnt, 0.0)
 
     raw = jnp.sum(
@@ -752,12 +851,13 @@ def score_gpu_share(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
 
 def run_scores(ns: NodeStatic, carry: Carry, pod: PodRow, weights: jnp.ndarray) -> jnp.ndarray:
     """Weighted sum of all normalized score plugins -> f32[N]."""
+    na_ok = node_affinity_mask(ns, pod)  # CSE-merged with run_filters' copy
     by_name = {
         "balanced_allocation": score_balanced(ns, carry, pod),
         "least_allocated": score_least_allocated(ns, carry, pod),
         "node_affinity": score_node_affinity(ns, pod),
         "taint_toleration": score_taint_toleration(ns, pod),
-        "topology_spread": score_topology_spread(ns, carry, pod),
+        "topology_spread": score_topology_spread(ns, carry, pod, na_ok),
         "inter_pod_affinity": score_inter_pod_affinity(ns, carry, pod),
         "prefer_avoid_pods": score_prefer_avoid(ns, pod),
         "simon": score_simon(ns, carry, pod),
@@ -789,6 +889,10 @@ def schedule_step(ns: NodeStatic, weights: jnp.ndarray, carry: Carry, pod: PodRo
     vg_free, dev_free, vg_take, dev_take = local_storage_commit(
         ns, carry, pod, onehot
     )
+    port_any, port_wild, port_ipc = ports_commit(carry, pod, onehot)
+    anti_counts = carry.anti_counts + (
+        pod.own_anti[:, None] * onehot.astype(jnp.float32)[None, :]
+    )
 
     reason_counts = jnp.zeros(NUM_FILTERS, jnp.int32).at[
         jnp.clip(first_fail, 0, NUM_FILTERS - 1)
@@ -798,6 +902,8 @@ def schedule_step(ns: NodeStatic, weights: jnp.ndarray, carry: Carry, pod: PodRo
     new_carry = Carry(
         free=free, sel_counts=sel_counts, gpu_free=gpu_free,
         vg_free=vg_free, dev_free=dev_free,
+        port_any=port_any, port_wild=port_wild, port_ipc=port_ipc,
+        anti_counts=anti_counts,
     )
     return new_carry, (
         node_out.astype(jnp.int32),

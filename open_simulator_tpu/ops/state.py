@@ -14,7 +14,7 @@ from typing import Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from .encode import Encoder, NodeTable, PodBatch, round_up
+from .encode import Encoder, NodeTable, PodBatch, port_table_sizes, round_up
 from .kernels import Carry, NodeStatic, PodRow
 
 
@@ -56,20 +56,43 @@ def node_static_from_table(enc: Encoder, table: NodeTable) -> NodeStatic:
         topo_onehot=jnp.asarray(topo_onehot),
         unsched_key_id=jnp.int32(enc.unsched_key_id),
         empty_val_id=jnp.int32(enc.empty_val_id),
+        anti_topo=jnp.asarray(anti_topo_array(enc)),
     )
 
 
+def anti_topo_array(enc: Encoder) -> np.ndarray:
+    """i32[AT] topo-key index per registered required-anti-affinity term."""
+    AT = max(len(enc.anti_terms), 1)
+    arr = np.full(AT, -1, np.int32)
+    for t, (k_idx, _sel) in enumerate(enc.anti_terms):
+        arr[t] = k_idx
+    return arr
+
+
 def carry_from_table(
-    table: NodeTable, sel_counts: Optional[np.ndarray] = None, num_selectors: int = 1
+    table: NodeTable,
+    sel_counts: Optional[np.ndarray] = None,
+    num_selectors: int = 1,
+    port_counts: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None,
+    anti_counts: Optional[np.ndarray] = None,
 ) -> Carry:
     if sel_counts is None:
         sel_counts = np.zeros((max(num_selectors, 1), table.n), np.float32)
+    if port_counts is None:
+        z = np.zeros((2, table.n), np.float32)
+        port_counts = (z, z, z)
+    if anti_counts is None:
+        anti_counts = np.zeros((1, table.n), np.float32)
     return Carry(
         free=jnp.asarray(table.free),
         sel_counts=jnp.asarray(sel_counts),
         gpu_free=jnp.asarray(table.gpu_free),
         vg_free=jnp.asarray(table.vg_free),
         dev_free=jnp.asarray(table.dev_free),
+        port_any=jnp.asarray(port_counts[0]),
+        port_wild=jnp.asarray(port_counts[1]),
+        port_ipc=jnp.asarray(port_counts[2]),
+        anti_counts=jnp.asarray(anti_counts),
     )
 
 
@@ -113,15 +136,40 @@ def pod_rows_from_batch(batch: PodBatch) -> PodRow:
         has_local=jnp.asarray(batch.has_local),
         match_sel=jnp.asarray(batch.match_sel),
         owned_by_rs=jnp.asarray(batch.owned_by_rs),
+        hp_pid=jnp.asarray(batch.hp_pid),
+        hp_wild=jnp.asarray(batch.hp_wild),
+        hp_ipid=jnp.asarray(batch.hp_ipid),
+        match_anti=jnp.asarray(batch.match_anti),
+        own_anti=jnp.asarray(batch.own_anti),
         valid=jnp.asarray(batch.valid),
     )
 
 
-def align_sel_counts(carry: Carry, num_selectors: int) -> Carry:
-    """Grow the selector axis when a later app introduces new selectors."""
-    S_old, N = carry.sel_counts.shape
-    S = max(num_selectors, 1)
-    if S <= S_old:
-        return carry
-    grown = jnp.zeros((S, N), jnp.float32).at[:S_old].set(carry.sel_counts)
-    return carry._replace(sel_counts=grown)
+def _grow_rows(arr: jnp.ndarray, rows: int) -> jnp.ndarray:
+    old, N = arr.shape
+    if rows <= old:
+        return arr
+    return jnp.zeros((rows, N), arr.dtype).at[:old].set(arr)
+
+
+def align_carry(
+    carry: Carry, enc: Encoder, ns: Optional[NodeStatic] = None
+) -> Carry | Tuple[Carry, NodeStatic]:
+    """Grow the selector/port/anti axes when a later batch registers new
+    entries; counts accumulated so far are preserved in place (ids are
+    append-only). Pass `ns` to also regrow NodeStatic.anti_topo in lockstep
+    (its AT axis must match carry.anti_counts / pod.match_anti for the vmap in
+    pod_affinity_mask); returns (carry, ns) in that case."""
+    PID, PIP = port_table_sizes(enc)
+    grown = carry._replace(
+        sel_counts=_grow_rows(carry.sel_counts, max(len(enc.selectors), 1)),
+        port_any=_grow_rows(carry.port_any, PID),
+        port_wild=_grow_rows(carry.port_wild, PID),
+        port_ipc=_grow_rows(carry.port_ipc, PIP),
+        anti_counts=_grow_rows(carry.anti_counts, max(len(enc.anti_terms), 1)),
+    )
+    if ns is None:
+        return grown
+    if ns.anti_topo.shape[0] < grown.anti_counts.shape[0]:
+        ns = ns._replace(anti_topo=jnp.asarray(anti_topo_array(enc)))
+    return grown, ns

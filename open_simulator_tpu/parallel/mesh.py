@@ -57,6 +57,7 @@ def node_sharding(mesh: Mesh) -> NodeStatic:
         topo_onehot=s(None, None, NODE_AXIS),
         unsched_key_id=s(),
         empty_val_id=s(),
+        anti_topo=s(None),       # small, replicated
     )
 
 
@@ -68,6 +69,10 @@ def carry_sharding(mesh: Mesh) -> Carry:
         gpu_free=s(NODE_AXIS, None),
         vg_free=s(NODE_AXIS, None),
         dev_free=s(NODE_AXIS, None),
+        port_any=s(None, NODE_AXIS),
+        port_wild=s(None, NODE_AXIS),
+        port_ipc=s(None, NODE_AXIS),
+        anti_counts=s(None, NODE_AXIS),
     )
 
 

@@ -1,0 +1,396 @@
+"""Round-2 filter-correctness tests: NodePorts, InterPodAffinity symmetry,
+and PodTopologySpread's eligible-only min — each against the reference
+semantics (vendored plugins/nodeports, interpodaffinity existing-pod
+anti-affinity, podtopologyspread calPreFilterState)."""
+
+import numpy as np
+
+from open_simulator_tpu.core.matcher import ports_conflict
+from open_simulator_tpu.core.objects import Node, Pod
+from open_simulator_tpu.engine.simulator import (
+    AppResource,
+    ClusterResource,
+    simulate,
+)
+from open_simulator_tpu.ops.encode import (
+    Encoder,
+    encode_nodes,
+    encode_pods,
+    initial_anti_counts,
+    initial_port_counts,
+    initial_selector_counts,
+)
+from open_simulator_tpu.ops.kernels import (
+    F_NODE_PORTS,
+    schedule_batch,
+    weights_array,
+)
+from open_simulator_tpu.ops.state import (
+    carry_from_table,
+    node_static_from_table,
+    pod_rows_from_batch,
+)
+
+
+def mknode(name, cpu="8", mem="16Gi", labels=None):
+    return Node.from_dict(
+        {
+            "metadata": {"name": name, "labels": labels or {}},
+            "status": {"allocatable": {"cpu": cpu, "memory": mem, "pods": "110"}},
+        }
+    )
+
+
+def mkpod(name, ns="default", labels=None, ports=None, **spec_extra):
+    spec = {
+        "containers": [
+            {
+                "name": "c",
+                "resources": {"requests": {"cpu": "100m", "memory": "64Mi"}},
+                **({"ports": ports} if ports else {}),
+            }
+        ]
+    }
+    spec.update(spec_extra)
+    return Pod.from_dict(
+        {"metadata": {"name": name, "namespace": ns, "labels": labels or {}},
+         "spec": spec}
+    )
+
+
+def run_batch(nodes, pods, placed=()):
+    enc = Encoder()
+    enc.register_pods(pods)
+    for p, _ in placed:
+        enc.register_pods([p])
+    table = encode_nodes(enc, nodes)
+    batch = encode_pods(enc, pods)
+    ns = node_static_from_table(enc, table)
+    carry = carry_from_table(
+        table,
+        initial_selector_counts(enc, table, list(placed)),
+        port_counts=initial_port_counts(enc, table, list(placed)),
+        anti_counts=initial_anti_counts(enc, table, list(placed)),
+    )
+    rows = pod_rows_from_batch(batch)
+    _, placed_idx, reasons, *_ = schedule_batch(ns, carry, rows, weights_array())
+    names = [
+        table.names[i] if i >= 0 else None
+        for i in np.asarray(placed_idx)[: len(pods)]
+    ]
+    return names, np.asarray(reasons)[: len(pods)]
+
+
+# ---------------------------------------------------------------------------
+# NodePorts
+# ---------------------------------------------------------------------------
+
+def test_ports_conflict_same_port_one_node():
+    nodes = [mknode("n0")]
+    pods = [
+        mkpod("a", ports=[{"containerPort": 80, "hostPort": 8080}]),
+        mkpod("b", ports=[{"containerPort": 80, "hostPort": 8080}]),
+    ]
+    names, reasons = run_batch(nodes, pods)
+    assert names[0] == "n0"
+    assert names[1] is None
+    assert reasons[1][F_NODE_PORTS] == 1
+
+
+def test_ports_no_conflict_different_port_or_protocol():
+    nodes = [mknode("n0")]
+    pods = [
+        mkpod("a", ports=[{"hostPort": 8080}]),
+        mkpod("b", ports=[{"hostPort": 8081}]),
+        mkpod("c", ports=[{"hostPort": 8080, "protocol": "UDP"}]),
+    ]
+    names, _ = run_batch(nodes, pods)
+    assert names == ["n0", "n0", "n0"]
+
+
+def test_ports_second_node_takes_conflicting_pod():
+    nodes = [mknode("n0"), mknode("n1")]
+    pods = [
+        mkpod("a", ports=[{"hostPort": 9000}]),
+        mkpod("b", ports=[{"hostPort": 9000}]),
+    ]
+    names, _ = run_batch(nodes, pods)
+    assert set(names) == {"n0", "n1"}
+
+
+def test_ports_wildcard_vs_specific_ip():
+    # specific-IP ports on different IPs coexist; wildcard clashes with any
+    nodes = [mknode("n0")]
+    pods = [
+        mkpod("a", ports=[{"hostPort": 443, "hostIP": "10.0.0.1"}]),
+        mkpod("b", ports=[{"hostPort": 443, "hostIP": "10.0.0.2"}]),
+        mkpod("c", ports=[{"hostPort": 443}]),  # wildcard: conflicts
+    ]
+    names, reasons = run_batch(nodes, pods)
+    assert names[0] == "n0" and names[1] == "n0"
+    assert names[2] is None and reasons[2][F_NODE_PORTS] == 1
+
+
+def test_ports_specific_ip_blocked_by_wildcard():
+    nodes = [mknode("n0")]
+    pods = [
+        mkpod("a", ports=[{"hostPort": 53}]),                        # wildcard
+        mkpod("b", ports=[{"hostPort": 53, "hostIP": "10.0.0.9"}]),  # specific
+    ]
+    names, reasons = run_batch(nodes, pods)
+    assert names[0] == "n0" and names[1] is None
+    assert reasons[1][F_NODE_PORTS] == 1
+
+
+def test_ports_conflict_with_prebound_pod():
+    nodes = [mknode("n0")]
+    bound = mkpod("old", ports=[{"hostPort": 8443}])
+    bound.node_name = "n0"
+    names, reasons = run_batch(
+        nodes, [mkpod("new", ports=[{"hostPort": 8443}])], placed=[(bound, "n0")]
+    )
+    assert names[0] is None
+    assert reasons[0][F_NODE_PORTS] == 1
+
+
+def test_ports_host_network_container_port():
+    # hostNetwork pods claim their containerPorts as host ports
+    nodes = [mknode("n0")]
+    pods = [
+        mkpod("a", ports=[{"containerPort": 10250}], hostNetwork=True),
+        mkpod("b", ports=[{"containerPort": 10250}], hostNetwork=True),
+    ]
+    names, reasons = run_batch(nodes, pods)
+    assert names[0] == "n0" and names[1] is None
+
+
+def test_ports_kernel_agrees_with_oracle_randomized():
+    rng = np.random.default_rng(7)
+    protos = ["TCP", "UDP"]
+    ips = ["", "10.0.0.1", "10.0.0.2"]
+    for trial in range(20):
+        def rand_ports(k):
+            return [
+                {
+                    "hostPort": int(rng.integers(8000, 8004)),
+                    "protocol": protos[rng.integers(0, 2)],
+                    **(
+                        {"hostIP": ips[rng.integers(0, 3)]}
+                        if rng.random() < 0.5
+                        else {}
+                    ),
+                }
+                for _ in range(k)
+            ]
+
+        bound = mkpod("old", ports=rand_ports(int(rng.integers(1, 3))))
+        bound.node_name = "n0"
+        new = mkpod("new", ports=rand_ports(int(rng.integers(1, 3))))
+        names, reasons = run_batch([mknode("n0")], [new], placed=[(bound, "n0")])
+        expect_conflict = ports_conflict(new.host_ports, bound.host_ports)
+        got_conflict = names[0] is None
+        assert got_conflict == expect_conflict, (
+            f"trial {trial}: want={new.host_ports} used={bound.host_ports} "
+            f"kernel={'conflict' if got_conflict else 'ok'}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# InterPodAffinity symmetry (existing pods' required anti-affinity)
+# ---------------------------------------------------------------------------
+
+def _anti_affinity(match_labels, topo="topology.kubernetes.io/zone"):
+    return {
+        "podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {
+                    "labelSelector": {"matchLabels": match_labels},
+                    "topologyKey": topo,
+                }
+            ]
+        }
+    }
+
+
+def test_anti_affinity_symmetry_repels_matching_incomer():
+    # carrier placed in zone-a has anti-affinity against app=web; an incoming
+    # app=web pod (with NO anti-affinity of its own) must avoid zone-a.
+    nodes = [
+        mknode("a0", labels={"topology.kubernetes.io/zone": "az-a"}),
+        mknode("b0", labels={"topology.kubernetes.io/zone": "az-b"}),
+    ]
+    carrier = mkpod(
+        "carrier", labels={"app": "db"}, affinity=_anti_affinity({"app": "web"})
+    )
+    web = mkpod("web-1", labels={"app": "web"})
+    names, _ = run_batch(nodes, [carrier, web])
+    assert names[0] is not None
+    carrier_zone = names[0][0]  # 'a' or 'b'
+    assert names[1] is not None
+    assert names[1][0] != carrier_zone
+
+
+def test_anti_affinity_symmetry_prebound_carrier():
+    nodes = [
+        mknode("a0", labels={"topology.kubernetes.io/zone": "az-a"}),
+        mknode("b0", labels={"topology.kubernetes.io/zone": "az-b"}),
+    ]
+    carrier = mkpod(
+        "carrier", labels={"app": "db"}, affinity=_anti_affinity({"app": "web"})
+    )
+    carrier.node_name = "a0"
+    web = mkpod("web-1", labels={"app": "web"})
+    names, _ = run_batch(nodes, [web], placed=[(carrier, "a0")])
+    assert names[0] == "b0"
+
+
+def test_anti_affinity_symmetry_nonmatching_unaffected():
+    nodes = [
+        mknode("a0", labels={"topology.kubernetes.io/zone": "az-a"}),
+    ]
+    carrier = mkpod(
+        "carrier", labels={"app": "db"}, affinity=_anti_affinity({"app": "web"})
+    )
+    carrier.node_name = "a0"
+    other = mkpod("other", labels={"app": "cache"})
+    names, _ = run_batch(nodes, [other], placed=[(carrier, "a0")])
+    assert names[0] == "a0"
+
+
+def test_anti_affinity_symmetry_namespace_scoped():
+    # the carrier's term selects within its own namespace only; an incomer in
+    # another namespace is not repelled
+    nodes = [mknode("a0", labels={"topology.kubernetes.io/zone": "az-a"})]
+    carrier = mkpod(
+        "carrier", ns="prod", labels={"app": "db"},
+        affinity=_anti_affinity({"app": "web"}),
+    )
+    carrier.node_name = "a0"
+    foreign = mkpod("web-x", ns="dev", labels={"app": "web"})
+    names, _ = run_batch(nodes, [foreign], placed=[(carrier, "a0")])
+    assert names[0] == "a0"
+
+
+def test_anti_affinity_symmetry_e2e_simulate():
+    # through the full engine (grouped path): one carrier, then 2 web pods on
+    # a 2-zone/4-node cluster — web pods must all land outside the carrier zone
+    nodes = [
+        mknode("a0", labels={"topology.kubernetes.io/zone": "az-a"}),
+        mknode("a1", labels={"topology.kubernetes.io/zone": "az-a"}),
+        mknode("b0", labels={"topology.kubernetes.io/zone": "az-b"}),
+        mknode("b1", labels={"topology.kubernetes.io/zone": "az-b"}),
+    ]
+    carrier = mkpod(
+        "carrier", labels={"app": "db"}, affinity=_anti_affinity({"app": "web"})
+    )
+    carrier.node_name = "a0"
+    carrier.phase = "Running"
+    cluster = ClusterResource(
+        nodes=nodes, pods=[carrier]
+    )
+    app = AppResource(
+        name="web",
+        objects=[
+            {
+                "apiVersion": "apps/v1",
+                "kind": "Deployment",
+                "metadata": {"name": "web", "namespace": "default"},
+                "spec": {
+                    "replicas": 2,
+                    "selector": {"matchLabels": {"app": "web"}},
+                    "template": {
+                        "metadata": {"labels": {"app": "web"}},
+                        "spec": {
+                            "containers": [
+                                {
+                                    "name": "c",
+                                    "resources": {
+                                        "requests": {"cpu": "100m", "memory": "64Mi"}
+                                    },
+                                }
+                            ]
+                        },
+                    },
+                },
+            }
+        ],
+    )
+    result = simulate(cluster, [app])
+    assert not result.unscheduled
+    for st in result.node_status:
+        web_here = [p for p in st.pods if p.meta.labels.get("app") == "web"]
+        if web_here:
+            assert st.node.name.startswith("b"), (
+                f"web pod landed in the carrier zone on {st.node.name}"
+            )
+
+
+# ---------------------------------------------------------------------------
+# PodTopologySpread: min over eligible domains only
+# ---------------------------------------------------------------------------
+
+def test_spread_min_ignores_ineligible_domains():
+    # zone-b is excluded by the pod's nodeSelector; zone-a already has one
+    # matching pod. With maxSkew=1 and the global (buggy) min of 0 from
+    # zone-b, skew would be 2 and the pod would be wrongly rejected; the
+    # eligible-only min is 1, so it must schedule into zone-a.
+    nodes = [
+        mknode("a0", labels={
+            "topology.kubernetes.io/zone": "az-a", "pool": "x"}),
+        mknode("b0", labels={
+            "topology.kubernetes.io/zone": "az-b", "pool": "y"}),
+    ]
+    existing = mkpod("web-0", labels={"app": "web"})
+    existing.node_name = "a0"
+    incoming = mkpod(
+        "web-1",
+        labels={"app": "web"},
+        nodeSelector={"pool": "x"},
+        topologySpreadConstraints=[
+            {
+                "maxSkew": 1,
+                "topologyKey": "topology.kubernetes.io/zone",
+                "whenUnsatisfiable": "DoNotSchedule",
+                "labelSelector": {"matchLabels": {"app": "web"}},
+            }
+        ],
+    )
+    names, reasons = run_batch(nodes, [incoming], placed=[(existing, "a0")])
+    assert names[0] == "a0", reasons[0]
+
+
+def test_spread_counts_exclude_ineligible_nodes():
+    # matching pods on ineligible nodes must not count toward the candidate
+    # domain's total: zone-a holds 2 matching pods but one sits on a node the
+    # incomer can't use (different pool) — upstream still counts ONLY eligible
+    # nodes' pods, so the domain count is 1, min is 0 (empty eligible zone-b
+    # node), skew = 2 > 1 => a0 fails but b0 (eligible, count 0) passes.
+    nodes = [
+        mknode("a0", labels={
+            "topology.kubernetes.io/zone": "az-a", "pool": "x"}),
+        mknode("a1", labels={
+            "topology.kubernetes.io/zone": "az-a", "pool": "y"}),
+        mknode("b0", labels={
+            "topology.kubernetes.io/zone": "az-b", "pool": "x"}),
+    ]
+    on_elig = mkpod("w0", labels={"app": "web"})
+    on_elig.node_name = "a0"
+    on_inelig = mkpod("w1", labels={"app": "web"})
+    on_inelig.node_name = "a1"
+    incoming = mkpod(
+        "w2",
+        labels={"app": "web"},
+        nodeSelector={"pool": "x"},
+        topologySpreadConstraints=[
+            {
+                "maxSkew": 1,
+                "topologyKey": "topology.kubernetes.io/zone",
+                "whenUnsatisfiable": "DoNotSchedule",
+                "labelSelector": {"matchLabels": {"app": "web"}},
+            }
+        ],
+    )
+    names, _ = run_batch(
+        nodes, [incoming], placed=[(on_elig, "a0"), (on_inelig, "a1")]
+    )
+    assert names[0] == "b0"
