@@ -26,7 +26,9 @@ import numpy as np
 
 from ..core.matcher import match_label_selector
 from ..core.objects import (
+    ANNO_GPU_COUNT_POD,
     ANNO_GPU_MEM_POD,
+    ANNO_POD_LOCAL_STORAGE,
     RESOURCE_GPU_COUNT,
     LabelSelector,
     Node,
@@ -96,6 +98,31 @@ class Vocab:
 
     def __len__(self) -> int:
         return len(self._ids)
+
+
+def _pod_row_sig(pod: Pod) -> Tuple:
+    """Encoding signature: pods with equal signatures produce identical
+    PodBatch rows (the name itself is never encoded). Mutable per-clone dicts
+    (labels, annotations-derived features, requests) are keyed by content;
+    spec-derived immutable structures that _clone_pod shares between replicas
+    (affinity, tolerations, spread constraints, host ports, nodeSelector) are
+    keyed by identity — distinct parses never share ids, so identity keying is
+    conservative (never merges pods that differ)."""
+    return (
+        pod.meta.namespace,
+        tuple(sorted(pod.meta.labels.items())),
+        tuple(sorted(pod.requests.items())),
+        pod.node_name,
+        pod.meta.owner_kind,
+        pod.meta.annotations.get(ANNO_GPU_MEM_POD),
+        pod.meta.annotations.get(ANNO_GPU_COUNT_POD),
+        pod.meta.annotations.get(ANNO_POD_LOCAL_STORAGE),
+        id(pod.affinity),
+        id(pod.tolerations),
+        id(pod.spread_constraints),
+        id(pod.host_ports),
+        id(pod.node_selector),
+    )
 
 
 @dataclass
@@ -219,8 +246,17 @@ class Encoder:
 
     def register_pods(self, pods: Sequence[Pod]) -> None:
         """Pre-register every resource name, topology key and selector used by
-        a pod batch, so caps and ids are stable before arrays are built."""
+        a pod batch, so caps and ids are stable before arrays are built.
+
+        Deduped by row signature: workload replicas are prototype clones
+        (core/workloads._clone_pod) whose registrations are identical, so one
+        representative per signature registers for the whole group."""
+        seen: Set[Tuple] = set()
         for pod in pods:
+            sig = _pod_row_sig(pod)
+            if sig in seen:
+                continue
+            seen.add(sig)
             for r in pod.requests:
                 self.resource_index(r)
             for c in pod.spread_constraints:
@@ -481,14 +517,34 @@ def encode_pods(
     pods: Sequence[Pod],
     p_pad: Optional[int] = None,
 ) -> PodBatch:
+    """Encode a pod batch.
+
+    Row-level dedup: workload replicas are prototype clones whose encoded rows
+    are identical (name excluded — it never becomes a feature), so only one
+    representative per `_pod_row_sig` runs the per-row Python encode (incl.
+    the O(S) selector matching); clones expand by a numpy gather. This is what
+    keeps 100k-pod × hundreds-of-workloads encodes in seconds."""
     enc.register_pods(pods)
     p = len(pods)
     P = p_pad if p_pad is not None else round_up(p)
     R = len(enc.resources)
     S = max(len(enc.selectors), 1)
 
+    reps: List[Pod] = []
+    rep_of: Dict[Tuple, int] = {}
+    inverse = np.empty(p, np.int64)
+    for i, pod in enumerate(pods):
+        sig = _pod_row_sig(pod)
+        j = rep_of.get(sig)
+        if j is None:
+            j = len(reps)
+            rep_of[sig] = j
+            reps.append(pod)
+        inverse[i] = j
+    D = len(reps)
+
     def cap(f, minimum=1):
-        return max((f(pod) for pod in pods), default=minimum) or minimum
+        return max((f(pod) for pod in reps), default=minimum) or minimum
 
     TERM = round_up(cap(lambda pd: len(pd.affinity.node_required)), 1)
     EXPR = round_up(
@@ -535,59 +591,59 @@ def encode_pods(
         ),
         1,
     )
-    vols = [pd.local_volumes() for pd in pods]
+    vols = [pd.local_volumes() for pd in reps]
     SV = round_up(max((max(len(l), len(d)) for l, d in vols), default=1), 2)
     HP = round_up(cap(lambda pd: len(pd.host_ports)), 2)
     AT = max(len(enc.anti_terms), 1)
 
     b = PodBatch(
-        req=np.zeros((P, R), np.float32),
-        has_req=np.zeros(P, bool),
-        node_name_id=np.zeros(P, np.int32),
-        gpu_mem=np.zeros(P, np.float32),
-        gpu_num=np.zeros(P, np.float32),
-        sel_op=np.zeros((P, TERM, EXPR), np.int32),
-        sel_key=np.zeros((P, TERM, EXPR), np.int32),
-        sel_val=np.zeros((P, TERM, EXPR, VAL), np.int32),
-        sel_num=np.zeros((P, TERM, EXPR), np.float32),
-        has_terms=np.zeros(P, bool),
-        ns_pair=np.zeros((P, NS), np.int32),
-        pref_weight=np.zeros((P, PREF), np.float32),
-        pref_op=np.zeros((P, PREF, EXPR), np.int32),
-        pref_key=np.zeros((P, PREF, EXPR), np.int32),
-        pref_val=np.zeros((P, PREF, EXPR, VAL), np.int32),
-        pref_num=np.zeros((P, PREF, EXPR), np.float32),
-        tol_key=np.zeros((P, TOL), np.int32),
-        tol_val=np.zeros((P, TOL), np.int32),
-        tol_exists=np.zeros((P, TOL), bool),
-        tol_effect=np.zeros((P, TOL), np.int32),
-        tol_valid=np.zeros((P, TOL), bool),
-        spread_topo=np.full((P, C), -1, np.int32),
-        spread_sel=np.zeros((P, C), np.int32),
-        spread_skew=np.zeros((P, C), np.float32),
-        spread_hard=np.zeros((P, C), bool),
-        aff_topo=np.full((P, A), -1, np.int32),
-        aff_sel=np.zeros((P, A), np.int32),
-        aff_anti=np.zeros((P, A), bool),
-        aff_required=np.zeros((P, A), bool),
-        aff_weight=np.zeros((P, A), np.float32),
-        lvm_req=np.zeros((P, SV), np.float32),
-        lvm_vg=np.zeros((P, SV), np.int32),
-        dev_req=np.zeros((P, SV), np.float32),
-        dev_media_ssd=np.zeros((P, SV), bool),
-        has_local=np.zeros(P, bool),
-        match_sel=np.zeros((P, S), bool),
-        owned_by_rs=np.zeros(P, bool),
-        hp_pid=np.zeros((P, HP), np.int32),
-        hp_wild=np.zeros((P, HP), bool),
-        hp_ipid=np.zeros((P, HP), np.int32),
-        match_anti=np.zeros((P, AT), bool),
-        own_anti=np.zeros((P, AT), np.float32),
-        valid=np.zeros(P, bool),
+        req=np.zeros((D, R), np.float32),
+        has_req=np.zeros(D, bool),
+        node_name_id=np.zeros(D, np.int32),
+        gpu_mem=np.zeros(D, np.float32),
+        gpu_num=np.zeros(D, np.float32),
+        sel_op=np.zeros((D, TERM, EXPR), np.int32),
+        sel_key=np.zeros((D, TERM, EXPR), np.int32),
+        sel_val=np.zeros((D, TERM, EXPR, VAL), np.int32),
+        sel_num=np.zeros((D, TERM, EXPR), np.float32),
+        has_terms=np.zeros(D, bool),
+        ns_pair=np.zeros((D, NS), np.int32),
+        pref_weight=np.zeros((D, PREF), np.float32),
+        pref_op=np.zeros((D, PREF, EXPR), np.int32),
+        pref_key=np.zeros((D, PREF, EXPR), np.int32),
+        pref_val=np.zeros((D, PREF, EXPR, VAL), np.int32),
+        pref_num=np.zeros((D, PREF, EXPR), np.float32),
+        tol_key=np.zeros((D, TOL), np.int32),
+        tol_val=np.zeros((D, TOL), np.int32),
+        tol_exists=np.zeros((D, TOL), bool),
+        tol_effect=np.zeros((D, TOL), np.int32),
+        tol_valid=np.zeros((D, TOL), bool),
+        spread_topo=np.full((D, C), -1, np.int32),
+        spread_sel=np.zeros((D, C), np.int32),
+        spread_skew=np.zeros((D, C), np.float32),
+        spread_hard=np.zeros((D, C), bool),
+        aff_topo=np.full((D, A), -1, np.int32),
+        aff_sel=np.zeros((D, A), np.int32),
+        aff_anti=np.zeros((D, A), bool),
+        aff_required=np.zeros((D, A), bool),
+        aff_weight=np.zeros((D, A), np.float32),
+        lvm_req=np.zeros((D, SV), np.float32),
+        lvm_vg=np.zeros((D, SV), np.int32),
+        dev_req=np.zeros((D, SV), np.float32),
+        dev_media_ssd=np.zeros((D, SV), bool),
+        has_local=np.zeros(D, bool),
+        match_sel=np.zeros((D, S), bool),
+        owned_by_rs=np.zeros(D, bool),
+        hp_pid=np.zeros((D, HP), np.int32),
+        hp_wild=np.zeros((D, HP), bool),
+        hp_ipid=np.zeros((D, HP), np.int32),
+        match_anti=np.zeros((D, AT), bool),
+        own_anti=np.zeros((D, AT), np.float32),
+        valid=np.zeros(D, bool),
         keys=[pd.key for pd in pods],
     )
 
-    for i, pod in enumerate(pods):
+    for i, pod in enumerate(reps):
         b.valid[i] = True
         b.has_req[i] = bool(pod.requests)
         b.owned_by_rs[i] = pod.meta.owner_kind in ("ReplicaSet", "ReplicationController")
@@ -641,8 +697,8 @@ def encode_pods(
             b.hp_pid[i, j] = pid
             b.hp_wild[i, j] = wild
             b.hp_ipid[i, j] = ipid
-        for t, (k_idx, sel_id) in enumerate(enc.anti_terms):
-            b.match_anti[i, t] = enc.selectors[sel_id].matches(pod)
+        for t, (_k_idx, sel_id) in enumerate(enc.anti_terms):
+            b.match_anti[i, t] = b.match_sel[i, sel_id]  # same SelectorEntry
         for aid in enc.anti_ids(pod):
             b.own_anti[i, aid] += 1.0
         lvm_vols, dev_vols = vols[i]
@@ -661,7 +717,19 @@ def encode_pods(
             b.dev_req[i, j] = np.float32(v.size / float(1 << 20))
             b.dev_media_ssd[i, j] = v.media_type == "ssd"
 
-    return b
+    # Expand representative rows to the full padded batch by gather.
+    expanded = {}
+    for f in b.__dataclass_fields__:
+        if f == "keys":
+            continue
+        arr = getattr(b, f)
+        out = np.zeros((P,) + arr.shape[1:], arr.dtype)
+        if f in ("spread_topo", "aff_topo"):
+            out[:] = -1  # pad rows keep the inactive sentinel
+        if p:
+            out[:p] = arr[inverse]
+        expanded[f] = out
+    return PodBatch(keys=b.keys, **expanded)
 
 
 def host_allocate_gpu(free: np.ndarray, mem: float, num: int) -> Optional[List[int]]:

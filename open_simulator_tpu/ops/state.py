@@ -170,6 +170,11 @@ def align_carry(
     )
     if ns is None:
         return grown
-    if ns.anti_topo.shape[0] < grown.anti_counts.shape[0]:
-        ns = ns._replace(anti_topo=jnp.asarray(anti_topo_array(enc)))
+    # Refresh anti_topo whenever its content is stale, not just on shape
+    # growth: the 0-term pad state and the 1-term state share shape (1,), so a
+    # first term registered after NodeStatic was built changes content only.
+    want = anti_topo_array(enc)
+    have = np.asarray(ns.anti_topo)
+    if have.shape != want.shape or not np.array_equal(have, want):
+        ns = ns._replace(anti_topo=jnp.asarray(want))
     return grown, ns
