@@ -44,6 +44,11 @@ def _add_apply(sub: argparse._SubParsersAction) -> None:
         "--extended-resources", default="",
         help="comma list: gpu,open-local (extended report views)",
     )
+    p.add_argument(
+        "--devices", type=int, default=1,
+        help="shard the node axis across this many JAX devices "
+        "(0 = all visible devices; 1 = single-device, the default)",
+    )
 
 
 def main(argv=None) -> int:
@@ -94,6 +99,7 @@ def main(argv=None) -> int:
                     out=out,
                     scheduler_config=args.default_scheduler_config,
                     use_greed=args.use_greed,
+                    devices=args.devices,
                 )
             finally:
                 if out is not None:

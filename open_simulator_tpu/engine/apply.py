@@ -131,6 +131,7 @@ def run_apply(
     input_fn=input,
     scheduler_config: str = "",
     use_greed: bool = False,
+    devices: int = 1,
 ) -> ApplyOutcome:
     import sys
 
@@ -141,15 +142,20 @@ def run_apply(
     apps = build_apps(cfg)
     new_node = load_new_node(cfg)
     weights = load_scheduler_config(scheduler_config).weights
+    mesh = None
+    if devices != 1:
+        from ..parallel.mesh import product_mesh
 
-    result = simulate(cluster, apps, weights=weights, use_greed=use_greed)
+        mesh = product_mesh(devices)
+
+    result = simulate(cluster, apps, weights=weights, use_greed=use_greed, mesh=mesh)
     plan: Optional[CapacityPlan] = None
 
     if result.unscheduled and new_node is not None:
         if interactive:
             result = _interactive_loop(
                 cluster, apps, new_node, result, out, input_fn, weights=weights,
-                use_greed=use_greed,
+                use_greed=use_greed, mesh=mesh,
             )
         elif auto_plan:
             print(
@@ -158,7 +164,8 @@ def run_apply(
                 file=out,
             )
             plan = plan_capacity(
-                cluster, apps, new_node, weights=weights, use_greed=use_greed
+                cluster, apps, new_node, weights=weights, use_greed=use_greed,
+                mesh=mesh,
             )
             if plan is None:
                 print("capacity search failed: workload does not fit", file=out)
@@ -184,6 +191,7 @@ def _interactive_loop(
     input_fn,
     weights=None,
     use_greed: bool = False,
+    mesh=None,
 ) -> SimulateResult:
     """The reference's manual loop (apply.go:203-259): add one node / show
     reasons / exit, re-simulating from scratch each iteration."""
@@ -206,5 +214,5 @@ def _interactive_loop(
             daemonsets=list(cluster.daemonsets),
             others=dict(cluster.others),
         )
-        result = simulate(trial, apps, weights=weights, use_greed=use_greed)
+        result = simulate(trial, apps, weights=weights, use_greed=use_greed, mesh=mesh)
     return result

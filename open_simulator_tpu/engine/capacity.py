@@ -82,6 +82,7 @@ def _probe(
     k: int,
     weights: Optional[dict],
     use_greed: bool = False,
+    mesh=None,
 ) -> SimulateResult:
     trial = ClusterResource(
         nodes=list(cluster.nodes) + new_fake_nodes(template, k),
@@ -89,7 +90,7 @@ def _probe(
         daemonsets=list(cluster.daemonsets),
         others=dict(cluster.others),
     )
-    return simulate(trial, apps, weights=weights, use_greed=use_greed)
+    return simulate(trial, apps, weights=weights, use_greed=use_greed, mesh=mesh)
 
 
 def plan_capacity(
@@ -99,6 +100,7 @@ def plan_capacity(
     max_new_nodes: int = 1 << 14,
     weights: Optional[dict] = None,
     use_greed: bool = False,
+    mesh=None,
 ) -> Optional[CapacityPlan]:
     """Minimum clones of `new_node` so every pod schedules and utilization
     gates pass. Returns None if even max_new_nodes doesn't suffice."""
@@ -108,7 +110,7 @@ def plan_capacity(
     def good(res: SimulateResult) -> bool:
         return not res.unscheduled and satisfy_resource_setting(res)
 
-    base = _probe(cluster, apps, new_node, 0, weights, use_greed)
+    base = _probe(cluster, apps, new_node, 0, weights, use_greed, mesh)
     attempts += 1
     if good(base):
         return CapacityPlan(0, base, attempts)
@@ -117,7 +119,7 @@ def plan_capacity(
     lo, hi = 0, 1
     hi_result = None
     while hi <= max_new_nodes:
-        hi_result = _probe(cluster, apps, new_node, hi, weights, use_greed)
+        hi_result = _probe(cluster, apps, new_node, hi, weights, use_greed, mesh)
         attempts += 1
         if good(hi_result):
             break
@@ -128,7 +130,7 @@ def plan_capacity(
     best, best_result = hi, hi_result
     while lo + 1 < hi:
         mid = (lo + hi) // 2
-        res = _probe(cluster, apps, new_node, mid, weights, use_greed)
+        res = _probe(cluster, apps, new_node, mid, weights, use_greed, mesh)
         attempts += 1
         if good(res):
             hi, best, best_result = mid, mid, res

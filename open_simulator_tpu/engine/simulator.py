@@ -160,9 +160,17 @@ class Simulator:
         cluster: ClusterResource,
         weights: Optional[dict] = None,
         use_greed: bool = False,
+        mesh=None,
     ) -> None:
+        """`mesh` (jax.sharding.Mesh or None): when set, the node axis of the
+        cluster state is sharded across the mesh devices and the same grouped
+        scheduling program runs under GSPMD — per-node filter/score work on
+        local shards, argmax/min-max/domain reductions as ICI collectives
+        (the production analog of the reference's 16-goroutine node fan-out,
+        parallelize/parallelism.go:26-57)."""
         self.cluster = cluster
         self.use_greed = use_greed
+        self.mesh = mesh
         self.weights = weights_array(weights or DEFAULT_WEIGHTS)
         self.enc = Encoder(topology_keys=("kubernetes.io/hostname",))
         self._bound: List[Tuple[Pod, str]] = []   # (pod, node name)
@@ -217,13 +225,28 @@ class Simulator:
         self._carry = carry_from_table(
             self._table, sel, port_counts=ports, anti_counts=anti
         )
+        self._reshard()
+
+    def _reshard(self) -> None:
+        """(Re-)pin the cluster state to the mesh shardings. Called whenever
+        ns/carry are rebuilt from host arrays (initial build, axis growth,
+        eviction reversal), so every grouped-scheduler jit call sees committed
+        sharded inputs and compiles the GSPMD program."""
+        if self.mesh is None:
+            return
+        from ..parallel.mesh import shard_state
+
+        self._ns, self._carry = shard_state(self.mesh, self._ns, self._carry)
 
     def _schedule_batch_host(self, pods: List[Pod]) -> List[UnscheduledPod]:
         """Encode one batch, scan it on device, decode placements."""
         if not pods:
             return []
         batch = encode_pods(self.enc, pods)
+        carry0, ns0 = self._carry, self._ns
         self._carry, self._ns = align_carry(self._carry, self.enc, self._ns)
+        if self._carry is not carry0 or self._ns is not ns0:
+            self._reshard()
         # Grouped path: identical results to the naive scan, but static
         # filter/score work is hoisted per run of identical pods.
         (
@@ -381,6 +404,7 @@ class Simulator:
             port_any=port_any, port_wild=port_wild, port_ipc=port_ipc,
             anti_counts=anti,
         )
+        self._reshard()
 
     def _order(self, pods: List[Pod]) -> List[Pod]:
         return order_pods(pods, self.cluster.nodes, use_greed=self.use_greed)
@@ -470,6 +494,9 @@ def simulate(
     apps: Sequence[AppResource],
     weights: Optional[dict] = None,
     use_greed: bool = False,
+    mesh=None,
 ) -> SimulateResult:
     """One-shot simulation (parity: simulator.Simulate, core.go:67-119)."""
-    return Simulator(cluster, weights=weights, use_greed=use_greed).run(apps)
+    return Simulator(
+        cluster, weights=weights, use_greed=use_greed, mesh=mesh
+    ).run(apps)

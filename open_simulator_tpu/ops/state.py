@@ -161,13 +161,19 @@ def align_carry(
     (its AT axis must match carry.anti_counts / pod.match_anti for the vmap in
     pod_affinity_mask); returns (carry, ns) in that case."""
     PID, PIP = port_table_sizes(enc)
-    grown = carry._replace(
-        sel_counts=_grow_rows(carry.sel_counts, max(len(enc.selectors), 1)),
-        port_any=_grow_rows(carry.port_any, PID),
-        port_wild=_grow_rows(carry.port_wild, PID),
-        port_ipc=_grow_rows(carry.port_ipc, PIP),
-        anti_counts=_grow_rows(carry.anti_counts, max(len(enc.anti_terms), 1)),
-    )
+    new = {
+        "sel_counts": _grow_rows(carry.sel_counts, max(len(enc.selectors), 1)),
+        "port_any": _grow_rows(carry.port_any, PID),
+        "port_wild": _grow_rows(carry.port_wild, PID),
+        "port_ipc": _grow_rows(carry.port_ipc, PIP),
+        "anti_counts": _grow_rows(carry.anti_counts, max(len(enc.anti_terms), 1)),
+    }
+    # preserve identity when nothing grew, so callers can use an `is` check
+    # to decide whether sharded state needs re-pinning
+    if all(v is getattr(carry, k) for k, v in new.items()):
+        grown = carry
+    else:
+        grown = carry._replace(**new)
     if ns is None:
         return grown
     # Refresh anti_topo whenever its content is stale, not just on shape

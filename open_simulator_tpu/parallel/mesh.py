@@ -31,6 +31,25 @@ def make_mesh(devices: Optional[Sequence] = None) -> Mesh:
     return Mesh(devices, (NODE_AXIS,))
 
 
+def product_mesh(n_devices: int = 0) -> Optional[Mesh]:
+    """Mesh for the product engine: first n_devices (or all when 0) of
+    jax.devices(). Returns None for n_devices==1 — single-device runs skip
+    sharding entirely."""
+    devices = jax.devices()
+    if n_devices < 0:
+        raise ValueError(f"--devices must be >= 0, got {n_devices}")
+    if n_devices == 1 or len(devices) == 1:
+        return None
+    if n_devices > 0:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"--devices {n_devices} requested but only "
+                f"{len(devices)} JAX devices are visible"
+            )
+        devices = devices[:n_devices]
+    return make_mesh(devices)
+
+
 def node_sharding(mesh: Mesh) -> NodeStatic:
     """PartitionSpecs for each NodeStatic leaf (node axis sharded)."""
     s = lambda *spec: NamedSharding(mesh, P(*spec))

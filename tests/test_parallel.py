@@ -48,6 +48,42 @@ def test_dryrun_multichip_entrypoint():
     dryrun_multichip(8)
 
 
+def test_product_engine_sharded_matches_single_device():
+    """simulate(mesh=...) — the PRODUCT path (grouped scheduler under GSPMD) —
+    must place every pod exactly where the single-device run does, on the
+    same fixture the e2e suite uses."""
+    import os
+
+    from open_simulator_tpu.api.config import SimonConfig
+    from open_simulator_tpu.engine.apply import build_apps, build_cluster
+    from open_simulator_tpu.engine.simulator import simulate
+    from open_simulator_tpu.parallel.mesh import product_mesh
+
+    from open_simulator_tpu.core.workloads import reset_name_rng
+
+    cfg = SimonConfig.load(
+        os.path.join(os.path.dirname(__file__), "fixtures", "simon-config.yaml")
+    )
+    # identical generated pod names across the two independent builds
+    reset_name_rng()
+    ref = simulate(build_cluster(cfg), build_apps(cfg))
+    reset_name_rng()
+    sharded = simulate(build_cluster(cfg), build_apps(cfg), mesh=product_mesh(8))
+
+    def placements(res):
+        return sorted(
+            (p.key, st.node.name) for st in res.node_status for p in st.pods
+        )
+
+    assert placements(sharded) == placements(ref)
+    assert [u.pod.key for u in sharded.unscheduled] == [
+        u.pod.key for u in ref.unscheduled
+    ]
+    assert [u.reason for u in sharded.unscheduled] == [
+        u.reason for u in ref.unscheduled
+    ]
+
+
 def test_tile_pod_batch_matches_full_encoding():
     """Tiling template rows must schedule identically to encoding every pod."""
     from open_simulator_tpu.core.objects import Node, Pod
