@@ -37,8 +37,8 @@ def new_fake_nodes(template: Node, count: int) -> List[Node]:
 
 
 def max_resource_limits() -> Tuple[float, float]:
-    """Env knobs MaxCPU / MaxMemory as percentages (pkg/type/const.go:29-31);
-    100 means no limit."""
+    """Env knobs MaxCPU / MaxMemory / MaxVG as percentages
+    (pkg/type/const.go:29-31); 100 means no limit."""
 
     def read(name: str) -> float:
         try:
@@ -47,14 +47,16 @@ def max_resource_limits() -> Tuple[float, float]:
             return 100.0
         return v if 0 < v <= 100 else 100.0
 
-    return read("MaxCPU"), read("MaxMemory")
+    return read("MaxCPU"), read("MaxMemory"), read("MaxVG")
 
 
 def satisfy_resource_setting(result: SimulateResult) -> bool:
-    """Cluster-average requested/allocatable must stay under MaxCPU/MaxMemory
-    (apply.go:689-775)."""
-    max_cpu, max_mem = max_resource_limits()
-    if max_cpu >= 100 and max_mem >= 100:
+    """Cluster-average requested/allocatable must stay under MaxCPU/MaxMemory,
+    and cluster-total VG requested/capacity under MaxVG (apply.go:689-775 —
+    occupancy rates truncate to whole percents and fail only when strictly
+    above the limit, matching the reference's int() + '>' comparison)."""
+    max_cpu, max_mem, max_vg = max_resource_limits()
+    if max_cpu >= 100 and max_mem >= 100 and max_vg >= 100:
         return True
     total_cpu = total_cpu_req = total_mem = total_mem_req = 0
     for st in result.node_status:
@@ -63,9 +65,17 @@ def satisfy_resource_setting(result: SimulateResult) -> bool:
         for pod in st.pods:
             total_cpu_req += pod.requests.get("cpu", 0)
             total_mem_req += pod.requests.get("memory", 0)
-    cpu_ok = total_cpu == 0 or (100.0 * total_cpu_req / total_cpu) <= max_cpu
-    mem_ok = total_mem == 0 or (100.0 * total_mem_req / total_mem) <= max_mem
-    return cpu_ok and mem_ok
+    cpu_ok = total_cpu == 0 or int(100.0 * total_cpu_req / total_cpu) <= max_cpu
+    mem_ok = total_mem == 0 or int(100.0 * total_mem_req / total_mem) <= max_mem
+    # VG occupancy from the post-simulation storage state (the reference reads
+    # the bind-updated node annotations; result.storage is that decode)
+    vg_cap = vg_req = 0
+    for st_name, storage in result.storage.items():
+        for vg in storage.vgs:
+            vg_cap += vg.capacity
+            vg_req += vg.requested
+    vg_ok = vg_cap == 0 or int(100.0 * vg_req / vg_cap) <= max_vg
+    return cpu_ok and mem_ok and vg_ok
 
 
 @dataclass

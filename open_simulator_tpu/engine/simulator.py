@@ -171,6 +171,13 @@ class Simulator:
         self.cluster = cluster
         self.use_greed = use_greed
         self.mesh = mesh
+        # Apiserver-grade validation before anything schedules: the reference
+        # validates every imported node and synthesized pod and fails the
+        # whole Simulate on the first invalid object (utils.go:495-508).
+        from ..core.validation import check_nodes, check_pods
+
+        check_nodes(cluster.nodes)
+        check_pods(cluster.pods, where="cluster")
         self.weights = weights_array(weights or DEFAULT_WEIGHTS)
         self.enc = Encoder(topology_keys=("kubernetes.io/hostname",))
         self._bound: List[Tuple[Pod, str]] = []   # (pod, node name)
@@ -411,6 +418,8 @@ class Simulator:
 
     # -- public ------------------------------------------------------------
     def run(self, apps: Sequence[AppResource]) -> SimulateResult:
+        from ..core.validation import check_pods
+
         app_pods: List[List[Pod]] = []
         for app in apps:
             pods: List[Pod] = []
@@ -418,6 +427,7 @@ class Simulator:
                 kind = obj.get("kind", "")
                 if kind in WORKLOAD_KINDS:
                     pods.extend(pods_from_workload(obj, nodes=self.cluster.nodes))
+            check_pods(pods, where=f"app {app.name}")
             app_pods.append(self._order(pods))
 
         self._build_device_state(

@@ -21,7 +21,7 @@ def _cluster():
             "metadata": {"name": "pending", "namespace": "d"},
             "spec": {
                 "containers": [
-                    {"name": "c", "resources": {"requests": {"cpu": "1", "memory": "1Gi"}}}
+                    {"name": "c", "image": "img", "resources": {"requests": {"cpu": "1", "memory": "1Gi"}}}
                 ]
             },
         }

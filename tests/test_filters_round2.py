@@ -46,6 +46,7 @@ def mkpod(name, ns="default", labels=None, ports=None, **spec_extra):
         "containers": [
             {
                 "name": "c",
+                "image": "img",
                 "resources": {"requests": {"cpu": "100m", "memory": "64Mi"}},
                 **({"ports": ports} if ports else {}),
             }
@@ -304,6 +305,7 @@ def test_anti_affinity_symmetry_e2e_simulate():
                             "containers": [
                                 {
                                     "name": "c",
+                                    "image": "img",
                                     "resources": {
                                         "requests": {"cpu": "100m", "memory": "64Mi"}
                                     },

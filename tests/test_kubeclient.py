@@ -43,7 +43,7 @@ def _pod(name, phase="Running", node="n1", owner_kind=None, deleting=False):
         "metadata": meta,
         "spec": {
             "nodeName": node if phase == "Running" else "",
-            "containers": [{"name": "c", "resources": {"requests": {"cpu": "1"}}}],
+            "containers": [{"name": "c", "image": "img", "resources": {"requests": {"cpu": "1"}}}],
         },
         "status": {"phase": phase},
     }
@@ -84,6 +84,7 @@ APIS = {
                             "containers": [
                                 {
                                     "name": "c",
+                                    "image": "img",
                                     "resources": {"requests": {"cpu": "100m"}},
                                 }
                             ]

@@ -32,7 +32,7 @@ def mkpod(name, cpu=None, mem=None, selector=None, tolerations=None, node=""):
         req["cpu"] = cpu
     if mem:
         req["memory"] = mem
-    spec = {"containers": [{"name": "c", "resources": {"requests": req}}]}
+    spec = {"containers": [{"name": "c", "image": "img", "resources": {"requests": req}}]}
     if selector:
         spec["nodeSelector"] = selector
     if tolerations:
@@ -112,7 +112,7 @@ def test_use_greed_end_to_end():
             "template": {
                 "spec": {
                     "containers": [
-                        {"name": "c", "resources": {"requests": {"cpu": "1"}}}
+                        {"name": "c", "image": "img", "resources": {"requests": {"cpu": "1"}}}
                     ]
                 }
             },

@@ -115,7 +115,7 @@ def test_tile_pod_batch_matches_full_encoding():
                 "metadata": {"name": name, "namespace": "d", "labels": {"app": "a"}},
                 "spec": {
                     "containers": [
-                        {"name": "c", "resources": {"requests": {"cpu": "1", "memory": "1Gi"}}}
+                        {"name": "c", "image": "img", "resources": {"requests": {"cpu": "1", "memory": "1Gi"}}}
                     ]
                 },
             }

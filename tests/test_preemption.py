@@ -38,7 +38,7 @@ def mknode(name, cpu="4", mem="8Gi", taints=None):
 def mkpod(name, cpu="1", priority=0, labels=None, ns="default", node="", policy=None):
     spec = {
         "containers": [
-            {"name": "c", "resources": {"requests": {"cpu": cpu}}}
+            {"name": "c", "image": "img", "resources": {"requests": {"cpu": cpu}}}
         ],
         "priority": priority,
     }

@@ -89,7 +89,7 @@ def test_weights_affect_placement():
                 "metadata": {"labels": {"app": "d"}},
                 "spec": {
                     "containers": [
-                        {"name": "c", "resources": {"requests": {"cpu": "1", "memory": "1Gi"}}}
+                        {"name": "c", "image": "img", "resources": {"requests": {"cpu": "1", "memory": "1Gi"}}}
                     ]
                 },
             },

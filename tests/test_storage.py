@@ -86,6 +86,7 @@ def storage_pod(name, volumes):
                 "containers": [
                     {
                         "name": "c",
+                        "image": "img",
                         "resources": {
                             "requests": {"cpu": "100m", "memory": "128Mi"}
                         },
@@ -256,7 +257,7 @@ def test_storage_free_pod_ignores_storage():
             "metadata": {"name": "p", "namespace": "stor"},
             "spec": {
                 "containers": [
-                    {"name": "c", "resources": {"requests": {"cpu": "1"}}}
+                    {"name": "c", "image": "img", "resources": {"requests": {"cpu": "1"}}}
                 ]
             },
         }
@@ -364,6 +365,7 @@ def test_statefulset_volume_claims_end_to_end():
                     "containers": [
                         {
                             "name": "c",
+                            "image": "img",
                             "resources": {
                                 "requests": {"cpu": "100m", "memory": "128Mi"}
                             },

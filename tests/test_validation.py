@@ -1,0 +1,173 @@
+"""Apiserver-grade validation tests (parity: pkg/utils/utils.go:495-508 via
+vendored pkg/apis/core/validation) and the MaxVG capacity gate
+(apply.go:689-775)."""
+
+import pytest
+
+from open_simulator_tpu.core.objects import Node, Pod
+from open_simulator_tpu.core.validation import (
+    ValidationError,
+    check_nodes,
+    check_pods,
+    validate_node,
+    validate_pod,
+)
+from open_simulator_tpu.engine.capacity import satisfy_resource_setting
+from open_simulator_tpu.engine.simulator import (
+    AppResource,
+    ClusterResource,
+    SimulateResult,
+    simulate,
+)
+
+
+def mkpod(name="p", ns="default", containers=None, **spec_extra):
+    spec = {
+        "containers": containers
+        if containers is not None
+        else [{"name": "c", "image": "img",
+               "resources": {"requests": {"cpu": "1"}}}],
+    }
+    spec.update(spec_extra)
+    return Pod.from_dict(
+        {"metadata": {"name": name, "namespace": ns}, "spec": spec}
+    )
+
+
+def test_valid_pod_passes():
+    assert validate_pod(mkpod()) == []
+
+
+def test_bad_name_rejected():
+    errs = validate_pod(mkpod(name="Bad_Name!"))
+    assert any("metadata.name" in e and "RFC 1123" in e for e in errs)
+
+
+def test_missing_name_rejected():
+    errs = validate_pod(mkpod(name=""))
+    assert any("metadata.name: Required value" in e for e in errs)
+
+
+def test_bad_namespace_rejected():
+    errs = validate_pod(mkpod(ns="Not.A.Label"))
+    assert any("metadata.namespace" in e for e in errs)
+
+
+def test_no_containers_rejected():
+    errs = validate_pod(mkpod(containers=[]))
+    assert any("spec.containers: Required value" in e for e in errs)
+
+
+def test_missing_image_rejected():
+    errs = validate_pod(mkpod(containers=[{"name": "c"}]))
+    assert any("spec.containers[0].image: Required value" in e for e in errs)
+
+
+def test_duplicate_container_names_rejected():
+    errs = validate_pod(
+        mkpod(containers=[{"name": "c", "image": "i"}, {"name": "c", "image": "i"}])
+    )
+    assert any("Duplicate value" in e for e in errs)
+
+
+def test_bad_restart_policy_rejected():
+    errs = validate_pod(mkpod(restartPolicy="WhenIFeelLikeIt"))
+    assert any("spec.restartPolicy: Unsupported value" in e for e in errs)
+
+
+def test_request_above_limit_rejected():
+    errs = validate_pod(
+        mkpod(
+            containers=[
+                {
+                    "name": "c",
+                    "image": "i",
+                    "resources": {
+                        "requests": {"cpu": "2"},
+                        "limits": {"cpu": "1"},
+                    },
+                }
+            ]
+        )
+    )
+    assert any("must be less than or equal to cpu limit" in e for e in errs)
+
+
+def test_bad_label_key_rejected():
+    p = mkpod()
+    p.meta.labels["-bad-"] = "x"
+    errs = validate_pod(p)
+    assert any("metadata.labels" in e for e in errs)
+
+
+def test_node_validation():
+    good = Node.from_dict(
+        {"metadata": {"name": "n-1"},
+         "status": {"allocatable": {"cpu": "4"}}}
+    )
+    assert validate_node(good) == []
+    bad = Node.from_dict({"metadata": {"name": "N_1!"}})
+    assert any("metadata.name" in e for e in validate_node(bad))
+    check_nodes([good])
+    with pytest.raises(ValidationError):
+        check_nodes([bad])
+
+
+def test_simulate_rejects_invalid_cluster_pod():
+    node = Node.from_dict(
+        {"metadata": {"name": "n0"},
+         "status": {"allocatable": {"cpu": "8", "memory": "16Gi", "pods": "110"}}}
+    )
+    bad = mkpod(containers=[{"name": "c"}])  # no image
+    with pytest.raises(ValidationError, match="image: Required value"):
+        simulate(ClusterResource(nodes=[node], pods=[bad]), [])
+
+
+def test_simulate_rejects_invalid_app_pod():
+    node = Node.from_dict(
+        {"metadata": {"name": "n0"},
+         "status": {"allocatable": {"cpu": "8", "memory": "16Gi", "pods": "110"}}}
+    )
+    app = AppResource(
+        name="bad",
+        objects=[
+            {
+                "kind": "Deployment",
+                "metadata": {"name": "Bad_Caps", "namespace": "default"},
+                "spec": {
+                    "replicas": 1,
+                    "template": {
+                        "spec": {"containers": [{"name": "c", "image": "i"}]}
+                    },
+                },
+            }
+        ],
+    )
+    with pytest.raises(ValidationError, match="app bad"):
+        simulate(ClusterResource(nodes=[node]), [app])
+
+
+# ---------------------------------------------------------------------------
+# MaxVG gate
+# ---------------------------------------------------------------------------
+
+def _vg_result(requested_pct: float) -> SimulateResult:
+    from open_simulator_tpu.core.objects import LocalVG, NodeLocalStorage
+
+    res = SimulateResult()
+    cap = 100 * (1 << 30)
+    res.storage["n0"] = NodeLocalStorage(
+        vgs=[LocalVG(name="pool", capacity=cap,
+                     requested=int(cap * requested_pct / 100.0))],
+        devices=[],
+    )
+    return res
+
+
+def test_max_vg_gate(monkeypatch):
+    monkeypatch.setenv("MaxVG", "50")
+    assert satisfy_resource_setting(_vg_result(40.0))
+    assert satisfy_resource_setting(_vg_result(50.0))  # int(50) <= 50
+    assert not satisfy_resource_setting(_vg_result(61.0))
+    monkeypatch.delenv("MaxVG")
+    assert satisfy_resource_setting(_vg_result(99.0))
