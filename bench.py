@@ -209,8 +209,11 @@ def main() -> int:
         from open_simulator_tpu.utils.platform import ensure_platform
 
         ensure_platform()
+    from open_simulator_tpu.utils.platform import enable_compilation_cache
 
-    from open_simulator_tpu.ops.grouped import schedule_batch_grouped
+    enable_compilation_cache()
+
+    from open_simulator_tpu.ops.fast import schedule_batch_fast
     from open_simulator_tpu.ops.kernels import weights_array
 
     t_enc0 = time.time()
@@ -223,11 +226,11 @@ def main() -> int:
     # (schedule_batch_grouped max_group_chunk) bounds each device program to a
     # few seconds — a single 100k-step scan trips the TPU worker's watchdog.
     t0 = time.time()
-    schedule_batch_grouped(ns, carry, batch, w)
+    schedule_batch_fast(ns, carry, batch, w)
     compile_s = time.time() - t0
 
     t1 = time.time()
-    _, placed, *_ = schedule_batch_grouped(ns, carry, batch, w)
+    _, placed, *_ = schedule_batch_fast(ns, carry, batch, w)
     run = time.time() - t1
     scheduled = int((placed >= 0).sum())
     pods_per_sec = args.pods / run

@@ -72,9 +72,12 @@ def main(argv=None) -> int:
 
     args = parser.parse_args(argv)
     if args.command in ("apply", "server"):
-        from ..utils.platform import ensure_platform
+        from ..utils.platform import enable_compilation_cache, ensure_platform
+        from ..utils.tracing import init_logging
 
+        init_logging()  # LogLevel env, parity: cmd/simon/simon.go:46-66
         ensure_platform()
+        enable_compilation_cache()
     if args.command == "version":
         print(f"simon-tpu version {VERSION}")
         return 0
@@ -92,6 +95,17 @@ def main(argv=None) -> int:
             cfg = SimonConfig.load(args.simon_config)
             out = open(args.output_file, "w") if args.output_file else None
             try:
+                ext = (
+                    [s.strip() for s in args.extended_resources.split(",") if s.strip()]
+                    if args.extended_resources
+                    else None
+                )
+                unknown = set(ext or ()) - {"gpu", "open-local"}
+                if unknown:
+                    raise ApplyError(
+                        f"--extended-resources: unknown resource(s) "
+                        f"{sorted(unknown)}; expected gpu, open-local"
+                    )
                 outcome = run_apply(
                     cfg,
                     interactive=args.interactive,
@@ -100,6 +114,7 @@ def main(argv=None) -> int:
                     scheduler_config=args.default_scheduler_config,
                     use_greed=args.use_greed,
                     devices=args.devices,
+                    extended_resources=ext,
                 )
             finally:
                 if out is not None:

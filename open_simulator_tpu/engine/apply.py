@@ -123,6 +123,39 @@ class ApplyOutcome:
     report: str = ""
 
 
+def select_apps(
+    apps: List[AppResource], out: TextIO, input_fn
+) -> List[AppResource]:
+    """Interactive multi-select of which apps to deploy (parity: the survey
+    MultiSelect prompt, apply.go:173-195). Accepts comma-separated indices or
+    names; empty input deploys everything."""
+    if not apps:
+        return apps
+    print("applications:", file=out)
+    for i, app in enumerate(apps):
+        print(f"  [{i}] {app.name}", file=out)
+    raw = input_fn("deploy which apps? (comma list of numbers/names, empty = all) ")
+    raw = (raw or "").strip()
+    if not raw:
+        return apps
+    chosen: List[AppResource] = []
+    by_name = {a.name: a for a in apps}
+    for tok in raw.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if tok.isdigit() and int(tok) < len(apps):
+            app = apps[int(tok)]
+        elif tok in by_name:
+            app = by_name[tok]
+        else:
+            print(f"  ignoring unknown app {tok!r}", file=out)
+            continue
+        if app not in chosen:
+            chosen.append(app)
+    return chosen or apps
+
+
 def run_apply(
     cfg: SimonConfig,
     interactive: bool = False,
@@ -132,30 +165,43 @@ def run_apply(
     scheduler_config: str = "",
     use_greed: bool = False,
     devices: int = 1,
+    extended_resources: Optional[List[str]] = None,
 ) -> ApplyOutcome:
     import sys
 
     from ..models.profiles import load_scheduler_config
 
+    from ..utils.tracing import span
+
+    report_to_file = out is not None and out is not sys.stdout
     out = out or sys.stdout
-    cluster = build_cluster(cfg)
-    apps = build_apps(cfg)
+    # Interactive prompts must stay visible on the terminal even when the
+    # report is routed to --output-file.
+    ui_out = sys.stderr if report_to_file else out
+    with span("build-cluster"):
+        cluster = build_cluster(cfg)
+    with span("render-apps"):
+        apps = build_apps(cfg)
+    if interactive:
+        apps = select_apps(apps, ui_out, input_fn)
     new_node = load_new_node(cfg)
-    weights = load_scheduler_config(scheduler_config).weights
+    profiles = load_scheduler_config(scheduler_config).profiles
     mesh = None
     if devices != 1:
         from ..parallel.mesh import product_mesh
 
         mesh = product_mesh(devices)
 
-    result = simulate(cluster, apps, weights=weights, use_greed=use_greed, mesh=mesh)
+    result = simulate(
+        cluster, apps, profiles=profiles, use_greed=use_greed, mesh=mesh
+    )
     plan: Optional[CapacityPlan] = None
 
     if result.unscheduled and new_node is not None:
         if interactive:
             result = _interactive_loop(
-                cluster, apps, new_node, result, out, input_fn, weights=weights,
-                use_greed=use_greed, mesh=mesh,
+                cluster, apps, new_node, result, ui_out, input_fn,
+                profiles=profiles, use_greed=use_greed, mesh=mesh,
             )
         elif auto_plan:
             print(
@@ -163,10 +209,11 @@ def run_apply(
                 f"minimum copies of node {new_node.name}...",
                 file=out,
             )
-            plan = plan_capacity(
-                cluster, apps, new_node, weights=weights, use_greed=use_greed,
-                mesh=mesh,
-            )
+            with span("capacity-search"):
+                plan = plan_capacity(
+                    cluster, apps, new_node, profiles=profiles,
+                    use_greed=use_greed, mesh=mesh,
+                )
             if plan is None:
                 print("capacity search failed: workload does not fit", file=out)
             else:
@@ -177,7 +224,7 @@ def run_apply(
                 )
                 result = plan.result
 
-    report = full_report(result)
+    report = full_report(result, extended_resources=extended_resources)
     print(report, file=out)
     return ApplyOutcome(result=result, plan=plan, report=report)
 
@@ -192,6 +239,7 @@ def _interactive_loop(
     weights=None,
     use_greed: bool = False,
     mesh=None,
+    profiles=None,
 ) -> SimulateResult:
     """The reference's manual loop (apply.go:203-259): add one node / show
     reasons / exit, re-simulating from scratch each iteration."""
@@ -214,5 +262,8 @@ def _interactive_loop(
             daemonsets=list(cluster.daemonsets),
             others=dict(cluster.others),
         )
-        result = simulate(trial, apps, weights=weights, use_greed=use_greed, mesh=mesh)
+        result = simulate(
+            trial, apps, weights=weights, use_greed=use_greed, mesh=mesh,
+            profiles=profiles,
+        )
     return result

@@ -93,6 +93,8 @@ def _probe(
     weights: Optional[dict],
     use_greed: bool = False,
     mesh=None,
+    n_pad: Optional[int] = None,
+    profiles=None,
 ) -> SimulateResult:
     trial = ClusterResource(
         nodes=list(cluster.nodes) + new_fake_nodes(template, k),
@@ -100,7 +102,31 @@ def _probe(
         daemonsets=list(cluster.daemonsets),
         others=dict(cluster.others),
     )
-    return simulate(trial, apps, weights=weights, use_greed=use_greed, mesh=mesh)
+    return simulate(
+        trial, apps, weights=weights, use_greed=use_greed, mesh=mesh,
+        n_pad=n_pad, profiles=profiles,
+    )
+
+
+def lower_bound_nodes(result: SimulateResult, template: Node) -> int:
+    """Heuristic node-count estimate from aggregate demand: k clones supply
+    k × the template's allocatable per resource, so ⌈unmet demand /
+    allocatable⌉ is usually close to the answer. NOT a true lower bound —
+    re-simulation can migrate already-placed pods onto clones and unlock
+    existing capacity for the unmet pods — so it only seeds the exponential
+    phase's first probe; the bisection still verifies the full [0, hi]
+    bracket (plan_capacity)."""
+    demand: dict = {"pods": 0}
+    for u in result.unscheduled:
+        demand["pods"] += 1
+        for res, q in u.pod.requests.items():
+            demand[res] = demand.get(res, 0) + q
+    k = 1
+    for res, q in demand.items():
+        alloc = template.allocatable.get(res, 0)
+        if q > 0 and alloc > 0:
+            k = max(k, -(-q // alloc))
+    return k
 
 
 def plan_capacity(
@@ -111,36 +137,54 @@ def plan_capacity(
     weights: Optional[dict] = None,
     use_greed: bool = False,
     mesh=None,
+    profiles=None,
 ) -> Optional[CapacityPlan]:
     """Minimum clones of `new_node` so every pod schedules and utilization
     gates pass. Returns None if even max_new_nodes doesn't suffice."""
 
+    from ..ops.encode import round_up
+
     attempts = 0
+    n_base = len(cluster.nodes)
 
     def good(res: SimulateResult) -> bool:
         return not res.unscheduled and satisfy_resource_setting(res)
 
-    base = _probe(cluster, apps, new_node, 0, weights, use_greed, mesh)
+    base = _probe(cluster, apps, new_node, 0, weights, use_greed, mesh,
+                  profiles=profiles)
     attempts += 1
     if good(base):
         return CapacityPlan(0, base, attempts)
 
-    # exponential growth to bracket, then bisect
-    lo, hi = 0, 1
+    # Exponential growth to bracket, seeded by the demand/supply estimate
+    # (skips most low probes), then bisect over the FULL [0, hi] range —
+    # the estimate is only a starting guess, so minimality never depends on
+    # it. Every probe of a phase is padded to the phase's bracket bucket so
+    # the node-axis shapes — and therefore the XLA executables — are
+    # identical across probes: the whole search compiles once per bucket
+    # instead of once per probe.
+    lo, hi = 0, max(min(lower_bound_nodes(base, new_node), max_new_nodes), 1)
     hi_result = None
     while hi <= max_new_nodes:
-        hi_result = _probe(cluster, apps, new_node, hi, weights, use_greed, mesh)
+        hi_result = _probe(
+            cluster, apps, new_node, hi, weights, use_greed, mesh,
+            n_pad=round_up(n_base + hi), profiles=profiles,
+        )
         attempts += 1
         if good(hi_result):
             break
-        lo = hi
+        lo = hi  # a failed probe IS a verified lower bound
         hi *= 2
     else:
         return None
     best, best_result = hi, hi_result
+    n_pad = round_up(n_base + hi)
     while lo + 1 < hi:
         mid = (lo + hi) // 2
-        res = _probe(cluster, apps, new_node, mid, weights, use_greed, mesh)
+        res = _probe(
+            cluster, apps, new_node, mid, weights, use_greed, mesh,
+            n_pad=n_pad, profiles=profiles,
+        )
         attempts += 1
         if good(res):
             hi, best, best_result = mid, mid, res

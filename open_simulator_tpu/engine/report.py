@@ -189,7 +189,17 @@ def unscheduled_report(result: SimulateResult) -> str:
     return render_table(headers, rows)
 
 
-def full_report(result: SimulateResult, extended: bool = True) -> str:
+def full_report(
+    result: SimulateResult,
+    extended: bool = True,
+    extended_resources=None,
+) -> str:
+    """Assembled report. `extended_resources` mirrors the reference's
+    --extended-resources flag (cmd/apply/apply.go:32; containLocalStorage /
+    containGpu gate the tables, apply.go:777-789): an explicit list shows
+    exactly the requested views ("open-local", "gpu"). None keeps the
+    show-everything-available default (a deliberate superset of the
+    reference's hide-by-default: the data is already computed)."""
     parts = [
         "=== Cluster ===",
         cluster_report(result),
@@ -197,10 +207,12 @@ def full_report(result: SimulateResult, extended: bool = True) -> str:
         placement_report(result),
     ]
     if extended:
-        stor = storage_report(result)
+        want_storage = extended_resources is None or "open-local" in extended_resources
+        want_gpu = extended_resources is None or "gpu" in extended_resources
+        stor = storage_report(result) if want_storage else ""
         if stor:
             parts += ["=== Local Storage ===", stor]
-        gpu = gpu_report(result)
+        gpu = gpu_report(result) if want_gpu else ""
         if gpu:
             parts += ["=== GPU Share ===", gpu]
     pre = preempted_report(result)

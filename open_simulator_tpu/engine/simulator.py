@@ -46,7 +46,7 @@ from ..ops.encode import (
     initial_port_counts,
     initial_selector_counts,
 )
-from ..ops.grouped import schedule_batch_grouped
+from ..ops.fast import schedule_batch_fast
 from ..ops.kernels import (
     FILTER_MESSAGES,
     NUM_FILTERS,
@@ -58,6 +58,7 @@ from ..ops.state import (
     carry_from_table,
     node_static_from_table,
 )
+from ..utils.tracing import progress, span
 
 
 @dataclass
@@ -161,6 +162,8 @@ class Simulator:
         weights: Optional[dict] = None,
         use_greed: bool = False,
         mesh=None,
+        n_pad: Optional[int] = None,
+        profiles=None,
     ) -> None:
         """`mesh` (jax.sharding.Mesh or None): when set, the node axis of the
         cluster state is sharded across the mesh devices and the same grouped
@@ -171,6 +174,10 @@ class Simulator:
         self.cluster = cluster
         self.use_greed = use_greed
         self.mesh = mesh
+        # Node-axis padding override: the capacity search pads every probe of
+        # a bisection bracket to the SAME bucket so XLA compiles once for the
+        # whole search (padded rows are valid=False and inert).
+        self.n_pad = n_pad
         # Apiserver-grade validation before anything schedules: the reference
         # validates every imported node and synthesized pod and fails the
         # whole Simulate on the first invalid object (utils.go:495-508).
@@ -179,6 +186,24 @@ class Simulator:
         check_nodes(cluster.nodes)
         check_pods(cluster.pods, where="cluster")
         self.weights = weights_array(weights or DEFAULT_WEIGHTS)
+        # Per-schedulerName profile map (parity: scheduler.WithProfiles,
+        # simulator.go:209 — each profile is its own framework; pods select
+        # one by spec.schedulerName). (weights f32[W], filter_on bool[F]|None).
+        if profiles:
+            self._profiles = {
+                p.scheduler_name: (weights_array(p.weights), p.filter_on_array())
+                for p in profiles
+            }
+            self.weights = self._profiles[profiles[0].scheduler_name][0]
+            # Safety net: a config whose only profile renames the scheduler
+            # would leave every default-named pod unschedulable (the reference
+            # would sit waiting for bind events forever in that misconfig) —
+            # apply the first profile to default-named pods instead.
+            self._profiles.setdefault(
+                DEFAULT_SCHEDULER, self._profiles[profiles[0].scheduler_name]
+            )
+        else:
+            self._profiles = {DEFAULT_SCHEDULER: (self.weights, None)}
         self.enc = Encoder(topology_keys=("kubernetes.io/hostname",))
         self._bound: List[Tuple[Pod, str]] = []   # (pod, node name)
         self._pending_cluster: List[Pod] = []
@@ -188,11 +213,24 @@ class Simulator:
                 # node_name/phase/annotations), and the caller's cluster must
                 # stay pristine for re-simulation by the capacity search.
                 self._bound.append((copy.deepcopy(pod), pod.node_name))
-            elif pod.scheduler_name == DEFAULT_SCHEDULER:
+            elif pod.scheduler_name in self._profiles:
                 # Copy: scheduling mutates node_name/phase, and the caller's
                 # cluster must stay pristine for re-simulation (the capacity
                 # search probes the same ClusterResource many times).
                 self._pending_cluster.append(copy.deepcopy(pod))
+            else:
+                # Parity: the reference's scheduler never sees pending pods
+                # of other schedulers (no framework for the name) and the
+                # simulation proceeds without them — but say so, since they
+                # reduce the simulated demand (app pods with unknown names
+                # DO fail loudly in _schedule_batch_host: they are part of
+                # the requested deployment, not pre-existing state).
+                from ..utils.tracing import log
+
+                log.warning(
+                    "ignoring pending cluster pod %s: no scheduler profile "
+                    "named %r", pod.key, pod.scheduler_name,
+                )
         # Cluster daemonsets expand against the final node list (core.go:85-96).
         for ds in cluster.daemonsets:
             self._pending_cluster.extend(pods_from_workload(ds, nodes=cluster.nodes))
@@ -224,6 +262,11 @@ class Simulator:
             self.cluster.nodes,
             existing_usage=aggregate_usage(self._bound),
             existing_gpu=aggregate_gpu_usage(self.cluster.nodes, self._bound),
+            n_pad=(
+                self.n_pad
+                if self.n_pad and self.n_pad >= len(self.cluster.nodes)
+                else None
+            ),
         )
         self._ns = node_static_from_table(self.enc, self._table)
         sel = initial_selector_counts(self.enc, self._table, self._bound)
@@ -246,24 +289,68 @@ class Simulator:
         self._ns, self._carry = shard_state(self.mesh, self._ns, self._carry)
 
     def _schedule_batch_host(self, pods: List[Pod]) -> List[UnscheduledPod]:
-        """Encode one batch, scan it on device, decode placements."""
+        """Dispatch a batch to its scheduler profiles: consecutive runs of one
+        schedulerName schedule together (sequential-commit order across the
+        whole batch is preserved exactly); pods naming an unconfigured
+        scheduler are unschedulable — the reference's scheduler simply never
+        sees them (no framework for that name), so the simulation would wait
+        forever; failing them with an explicit reason surfaces the mistake."""
+        failed: List[UnscheduledPod] = []
+        i = 0
+        while i < len(pods):
+            j = i
+            name = pods[i].scheduler_name
+            while j < len(pods) and pods[j].scheduler_name == name:
+                j += 1
+            run_pods = pods[i:j]
+            prof = self._profiles.get(name)
+            if prof is None:
+                failed.extend(
+                    UnscheduledPod(
+                        p, f"no scheduler profile named {name!r} is configured"
+                    )
+                    for p in run_pods
+                )
+            else:
+                failed.extend(self._schedule_run(run_pods, prof[0], prof[1]))
+            i = j
+        return failed
+
+    def _schedule_run(
+        self, pods: List[Pod], weights, filter_on
+    ) -> List[UnscheduledPod]:
+        """Encode one profile run, scan it on device, decode placements."""
         if not pods:
             return []
-        batch = encode_pods(self.enc, pods)
+        with span("encode", pods=len(pods)):
+            batch = encode_pods(self.enc, pods)
         carry0, ns0 = self._carry, self._ns
         self._carry, self._ns = align_carry(self._carry, self.enc, self._ns)
         if self._carry is not carry0 or self._ns is not ns0:
             self._reshard()
-        # Grouped path: identical results to the naive scan, but static
-        # filter/score work is hoisted per run of identical pods.
-        (
-            self._carry,
-            placed_np,
-            reasons_np,
-            take_np,
-            vg_np,
-            dev_np,
-        ) = schedule_batch_grouped(self._ns, self._carry, batch, self.weights)
+        # Fast path: identical results to the naive scan — static work hoisted
+        # per run of identical pods, big runs via per-node trajectories + the
+        # light selection scan (ops/fast.py).
+        import jax.numpy as jnp
+
+        with span("schedule", pods=len(pods)) as sp:
+            (
+                self._carry,
+                placed_np,
+                reasons_np,
+                take_np,
+                vg_np,
+                dev_np,
+            ) = schedule_batch_fast(
+                self._ns, self._carry, batch, weights,
+                filter_on=None if filter_on is None else jnp.asarray(filter_on),
+            )
+            scheduled = int((placed_np >= 0).sum())
+            sp.meta["scheduled"] = scheduled
+        progress(
+            "scheduled batch: %d/%d pods placed in %.2fs",
+            scheduled, len(pods), sp.duration,
+        )
         failed: List[UnscheduledPod] = []
         n_nodes = len(self.cluster.nodes)
         for i, pod in enumerate(pods):
@@ -420,43 +507,49 @@ class Simulator:
     def run(self, apps: Sequence[AppResource]) -> SimulateResult:
         from ..core.validation import check_pods
 
-        app_pods: List[List[Pod]] = []
-        for app in apps:
-            pods: List[Pod] = []
-            for obj in app.objects:
-                kind = obj.get("kind", "")
-                if kind in WORKLOAD_KINDS:
-                    pods.extend(pods_from_workload(obj, nodes=self.cluster.nodes))
-            check_pods(pods, where=f"app {app.name}")
-            app_pods.append(self._order(pods))
+        with span("simulate", nodes=len(self.cluster.nodes), apps=len(apps)):
+            app_pods: List[List[Pod]] = []
+            with span("expand-workloads"):
+                for app in apps:
+                    pods: List[Pod] = []
+                    for obj in app.objects:
+                        kind = obj.get("kind", "")
+                        if kind in WORKLOAD_KINDS:
+                            pods.extend(
+                                pods_from_workload(obj, nodes=self.cluster.nodes)
+                            )
+                    check_pods(pods, where=f"app {app.name}")
+                    app_pods.append(self._order(pods))
 
-        self._build_device_state(
-            self._pending_cluster + [p for pods in app_pods for p in pods]
-        )
+            with span("encode-cluster"):
+                self._build_device_state(
+                    self._pending_cluster + [p for pods in app_pods for p in pods]
+                )
 
-        result = SimulateResult()
-        # RunCluster: the cluster's own pending pods schedule first.
-        result.unscheduled.extend(
-            self._try_preemptions(
-                self._schedule_batch_host(self._order(self._pending_cluster))
-            )
-        )
-        # ScheduleApp: each app in configured order.
-        for pods in app_pods:
+            result = SimulateResult()
+            # RunCluster: the cluster's own pending pods schedule first.
             result.unscheduled.extend(
-                self._try_preemptions(self._schedule_batch_host(pods))
+                self._try_preemptions(
+                    self._schedule_batch_host(self._order(self._pending_cluster))
+                )
             )
+            # ScheduleApp: each app in configured order.
+            for pods in app_pods:
+                result.unscheduled.extend(
+                    self._try_preemptions(self._schedule_batch_host(pods))
+                )
 
-        by_node: Dict[str, NodeStatus] = {
-            n.name: NodeStatus(node=n) for n in self.cluster.nodes
-        }
-        for pod, node_name in self._bound:
-            if node_name in by_node:
-                by_node[node_name].pods.append(pod)
-        result.node_status = list(by_node.values())
-        result.storage = self._storage_status()
-        result.preempted = list(self._preempted)
-        return result
+            with span("decode-result"):
+                by_node: Dict[str, NodeStatus] = {
+                    n.name: NodeStatus(node=n) for n in self.cluster.nodes
+                }
+                for pod, node_name in self._bound:
+                    if node_name in by_node:
+                        by_node[node_name].pods.append(pod)
+                result.node_status = list(by_node.values())
+                result.storage = self._storage_status()
+                result.preempted = list(self._preempted)
+            return result
 
     def _storage_status(self) -> Dict[str, NodeLocalStorage]:
         """Decode the final vg_free/dev_free carry back into per-node storage
@@ -505,8 +598,11 @@ def simulate(
     weights: Optional[dict] = None,
     use_greed: bool = False,
     mesh=None,
+    n_pad: Optional[int] = None,
+    profiles=None,
 ) -> SimulateResult:
     """One-shot simulation (parity: simulator.Simulate, core.go:67-119)."""
     return Simulator(
-        cluster, weights=weights, use_greed=use_greed, mesh=mesh
+        cluster, weights=weights, use_greed=use_greed, mesh=mesh, n_pad=n_pad,
+        profiles=profiles,
     ).run(apps)

@@ -1,25 +1,30 @@
-"""Scheduler profiles: which score plugins run, at what weight.
+"""Scheduler profiles: plugin enable/disable, weights, multi-profile configs.
 
 Parity: the reference assembles a KubeSchedulerConfiguration programmatically —
 default provider plugins + Simon/Open-Local/Open-Gpu-Share injected, DefaultBinder
 disabled, PercentageOfNodesToScore pinned to 100
 (`/root/reference/pkg/simulator/utils.go:304-381`) — optionally merged with a
 user-supplied scheduler config file (`--default-scheduler-config`,
-`cmd/apply/apply.go:28`).
+`cmd/apply/apply.go:28`), then hands every profile to scheduler.New
+(`simulator.go:204-216`, WithProfiles...). Extenders in the user config are
+wired by the reference (WithExtenders) but unsupported here — rejected with an
+explicit error instead of silently dropped.
 
-Here a profile is the weight vector handed to the score kernels; filters always
-run (matching the default provider's filter set). Kube plugin names map to
-kernel names so user config files written for the reference keep working.
+A profile carries (a) the weight vector for the score kernels, (b) a
+bool[NUM_FILTERS] filter-enable mask honoring the config's Filter
+enable/disable lists, keyed by schedulerName. Kube plugin names map to kernel
+names so user config files written for the reference keep working.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
+import numpy as np
 import yaml
 
-from ..ops.kernels import DEFAULT_WEIGHTS
+from ..ops.kernels import DEFAULT_WEIGHTS, FILTER_PLUGIN_MAP, NUM_FILTERS
 
 # kube plugin name -> kernel score name
 PLUGIN_NAME_MAP = {
@@ -47,6 +52,12 @@ PLUGIN_NAME_MAP = {
 class SchedulerProfile:
     scheduler_name: str = "default-scheduler"
     weights: Dict[str, float] = field(default_factory=lambda: dict(DEFAULT_WEIGHTS))
+    # filter plugins enabled (index = kernels.F_*); Open-Local/Open-Gpu-Share
+    # filters stay on regardless — the reference injects them after the user
+    # config merge (utils.go:337-347)
+    filters_enabled: List[bool] = field(
+        default_factory=lambda: [True] * NUM_FILTERS
+    )
     percentage_of_nodes_to_score: int = 100  # simon pins 100 (utils.go:370)
 
     def with_plugin(self, kube_name: str, weight: float = 1.0) -> "SchedulerProfile":
@@ -61,6 +72,56 @@ class SchedulerProfile:
             self.weights[kernel] = 0.0
         return self
 
+    def disable_filter(self, kube_name: str) -> "SchedulerProfile":
+        idx = FILTER_PLUGIN_MAP.get(kube_name)
+        if idx is not None:
+            self.filters_enabled[idx] = False
+        return self
+
+    def enable_filter(self, kube_name: str) -> "SchedulerProfile":
+        idx = FILTER_PLUGIN_MAP.get(kube_name)
+        if idx is not None:
+            self.filters_enabled[idx] = True
+        return self
+
+    def filter_on_array(self) -> Optional[np.ndarray]:
+        """bool[NUM_FILTERS] for the kernels, or None when everything is on
+        (keeps the unprofiled jit cache entries)."""
+        if all(self.filters_enabled):
+            return None
+        return np.asarray(self.filters_enabled, bool)
+
+
+@dataclass
+class SchedulerConfig:
+    """All profiles of one KubeSchedulerConfiguration, keyed by scheduler
+    name. profiles[0] is the default profile (the reference forces
+    Profiles[0].SchedulerName = default-scheduler, utils.go:318)."""
+    profiles: List[SchedulerProfile] = field(
+        default_factory=lambda: [SchedulerProfile()]
+    )
+
+    @property
+    def default(self) -> SchedulerProfile:
+        return self.profiles[0]
+
+    # single-profile convenience accessors (most callers and the reference's
+    # own examples use exactly one profile)
+    @property
+    def weights(self) -> Dict[str, float]:
+        return self.default.weights
+
+    @property
+    def scheduler_name(self) -> str:
+        return self.default.scheduler_name
+
+    @property
+    def percentage_of_nodes_to_score(self) -> int:
+        return self.default.percentage_of_nodes_to_score
+
+    def by_name(self) -> Dict[str, SchedulerProfile]:
+        return {p.scheduler_name: p for p in self.profiles}
+
 
 def default_profile() -> SchedulerProfile:
     """Default provider score weights + Simon at 1 (utils.go:304-368 plus
@@ -68,25 +129,8 @@ def default_profile() -> SchedulerProfile:
     return SchedulerProfile()
 
 
-def load_scheduler_config(path: Optional[str]) -> SchedulerProfile:
-    """Merge a KubeSchedulerConfiguration YAML into the simon defaults.
-
-    Mirrors InitKubeSchedulerConfiguration: the user file's profile[0] score
-    plugin enable/disable list adjusts weights; simon's own plugins stay
-    enabled regardless (the reference injects them after merging)."""
-    profile = default_profile()
-    if not path:
-        return profile
-    with open(path, "r") as fh:
-        doc = yaml.safe_load(fh) or {}
-    kind = doc.get("kind", "")
-    if kind and kind != "KubeSchedulerConfiguration":
-        raise ValueError(f"{path}: expected KubeSchedulerConfiguration, got {kind}")
-    profiles = doc.get("profiles") or [{}]
-    p0 = profiles[0] or {}
-    if p0.get("schedulerName"):
-        profile.scheduler_name = p0["schedulerName"]
-    plugins = p0.get("plugins") or {}
+def _apply_profile_doc(profile: SchedulerProfile, p: dict) -> None:
+    plugins = p.get("plugins") or {}
     score = plugins.get("score") or {}
     for item in score.get("disabled") or []:
         name = item.get("name", "")
@@ -98,9 +142,62 @@ def load_scheduler_config(path: Optional[str]) -> SchedulerProfile:
             profile.without_plugin(name)
     for item in score.get("enabled") or []:
         profile.with_plugin(item.get("name", ""), float(item.get("weight") or 1))
-    pct = doc.get("percentageOfNodesToScore")
-    if pct:
-        # accepted for config-compat; the TPU engine always scores all nodes
-        # (simon pins 100 anyway)
-        profile.percentage_of_nodes_to_score = int(pct)
-    return profile
+    filt = plugins.get("filter") or {}
+    for item in filt.get("disabled") or []:
+        name = item.get("name", "")
+        if name == "*":
+            for kube_name in FILTER_PLUGIN_MAP:
+                profile.disable_filter(kube_name)
+        else:
+            profile.disable_filter(name)
+    for item in filt.get("enabled") or []:
+        profile.enable_filter(item.get("name", ""))
+
+
+def load_scheduler_config(path: Optional[str]) -> SchedulerConfig:
+    """Parse a KubeSchedulerConfiguration YAML into simon defaults.
+
+    Mirrors InitKubeSchedulerConfiguration: every profile's score plugin
+    enable/disable adjusts weights, filter enable/disable flips the filter
+    mask, multiple profiles are kept keyed by schedulerName; simon's own
+    plugins stay enabled regardless (the reference injects them after
+    merging). Extenders raise — the engine has no extender transport."""
+    cfg = SchedulerConfig()
+    if not path:
+        return cfg
+    with open(path, "r") as fh:
+        doc = yaml.safe_load(fh) or {}
+    kind = doc.get("kind", "")
+    if kind and kind != "KubeSchedulerConfiguration":
+        raise ValueError(f"{path}: expected KubeSchedulerConfiguration, got {kind}")
+    if doc.get("extenders"):
+        raise ValueError(
+            f"{path}: scheduler extenders are not supported by the TPU engine "
+            "(the reference wires them via HTTP, simulator.go:216; implement "
+            "the scoring as a plugin instead)"
+        )
+    profiles = doc.get("profiles") or [{}]
+    names = [
+        (p or {}).get("schedulerName", "default-scheduler") for p in profiles
+    ]
+    dupes = {n for n in names if names.count(n) > 1}
+    if dupes:
+        # kube's component-config validation rejects duplicate profile names
+        raise ValueError(
+            f"{path}: duplicate schedulerName(s) across profiles: "
+            f"{sorted(dupes)}"
+        )
+    cfg.profiles = []
+    for p in profiles:
+        p = p or {}
+        profile = default_profile()
+        if p.get("schedulerName"):
+            profile.scheduler_name = p["schedulerName"]
+        _apply_profile_doc(profile, p)
+        pct = doc.get("percentageOfNodesToScore")
+        if pct:
+            # accepted for config-compat; the TPU engine always scores all
+            # nodes (simon pins 100 anyway)
+            profile.percentage_of_nodes_to_score = int(pct)
+        cfg.profiles.append(profile)
+    return cfg

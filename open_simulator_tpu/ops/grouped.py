@@ -71,8 +71,11 @@ from .kernels import (
 from .state import pod_rows_from_batch
 
 
-def _static_parts(ns: NodeStatic, pod: PodRow, weights: jnp.ndarray):
-    """Masks/scores that do not depend on the scan carry."""
+def _static_parts(ns: NodeStatic, pod: PodRow, weights: jnp.ndarray, filter_on=None):
+    """Masks/scores that do not depend on the scan carry. `filter_on`
+    (bool[NUM_FILTERS] or None) disables filters per the scheduler profile —
+    note na_ok itself stays unmasked: PodTopologySpread eligibility reads the
+    pod spec directly regardless of the NodeAffinity plugin's enablement."""
     unsched_tolerated = jnp.any(
         pod.tol_valid
         & ((pod.tol_key == 0) | (pod.tol_key == ns.unsched_key_id))
@@ -89,6 +92,8 @@ def _static_parts(ns: NodeStatic, pod: PodRow, weights: jnp.ndarray):
         ],
         axis=1,
     )                                                   # [N,4]
+    if filter_on is not None:
+        static_fails = static_fails & filter_on[None, :4]
     static_ok = ~jnp.any(static_fails, axis=1)
     static_first_fail = jnp.where(
         jnp.any(static_fails, axis=1),
@@ -111,18 +116,24 @@ def schedule_group(
     group_size: int,
     valid_count: jnp.ndarray,
     weights: jnp.ndarray,
+    filter_on=None,
 ):
     """Schedule `group_size` copies of one pod spec; only the first
     `valid_count` steps commit. Returns (carry, nodes i32[G], reasons i32[G,F]).
     """
-    static_ok, static_ff, static_scores, na_ok = _static_parts(ns, pod, weights)
+    static_ok, static_ff, static_scores, na_ok = _static_parts(
+        ns, pod, weights, filter_on
+    )
+    fo = (
+        jnp.ones(NUM_FILTERS, bool) if filter_on is None else filter_on
+    )
 
     def step(c: Carry, i):
         active = i < valid_count
-        port_ok = ports_mask(c, pod)
-        res_fail = resource_fail(ns, c, pod)
-        spread_ok = spread_mask(ns, c, pod, na_ok)
-        aff_ok = pod_affinity_mask(ns, c, pod)
+        port_ok = ports_mask(c, pod) | ~fo[F_NODE_PORTS]
+        res_fail = resource_fail(ns, c, pod) & fo[F_RESOURCES]
+        spread_ok = spread_mask(ns, c, pod, na_ok) | ~fo[F_SPREAD]
+        aff_ok = pod_affinity_mask(ns, c, pod) | ~fo[F_POD_AFFINITY]
         # takes are re-derived inside local_storage_commit below; XLA CSE
         # collapses the two local_storage_eval calls within one jit
         storage_ok, _, _, storage_raw = local_storage_eval(ns, c, pod)
@@ -216,6 +227,14 @@ def schedule_group(
 _group_jit = jax.jit(schedule_group, static_argnames=("group_size",))
 
 
+def _group_call(ns, carry, pod, group_size, valid_count, weights, filter_on=None):
+    """_group_jit with filter_on omitted when default (keeps the all-on jit
+    cache entry shared with callers that never pass a profile)."""
+    if filter_on is None:
+        return _group_jit(ns, carry, pod, group_size, valid_count, weights)
+    return _group_jit(ns, carry, pod, group_size, valid_count, weights, filter_on)
+
+
 def _row_signature(batch: PodBatch) -> np.ndarray:
     """Byte-hash every pod row's feature arrays to detect identical specs.
     Uses the compiled 128-bit row hasher (native/osim_native.cpp) when
@@ -271,6 +290,7 @@ def schedule_batch_grouped(
     batch: PodBatch,
     weights,
     max_group_chunk: int = 16384,
+    filter_on=None,
 ) -> Tuple[Carry, np.ndarray, np.ndarray, np.ndarray]:
     """schedule_batch semantics via per-group inner scans.
 
@@ -296,8 +316,8 @@ def schedule_batch_grouped(
         while done < length:
             n = min(length - done, max_group_chunk)
             g = _bucket(n)
-            carry, (nodes, reasons, take, vg_take, dev_take) = _group_jit(
-                ns, carry, row, g, jnp.int32(n), weights
+            carry, (nodes, reasons, take, vg_take, dev_take) = _group_call(
+                ns, carry, row, g, jnp.int32(n), weights, filter_on
             )
             sl = slice(start + done, start + done + n)
             nodes_out[sl] = np.asarray(nodes)[:n]

@@ -52,6 +52,22 @@ F_STORAGE = 8
 F_GPU = 9
 NUM_FILTERS = 10
 
+# Kube filter-plugin name -> filter index, for KubeSchedulerConfiguration
+# enable/disable fidelity (utils.go:304-381 builds the full Filter plugin
+# set; a user config may disable in-tree filters). Open-Local/Open-Gpu-Share
+# are NOT listed: the reference injects them after the user config merge, so
+# disabling them never takes effect (utils.go:337-347).
+FILTER_PLUGIN_MAP = {
+    "NodeUnschedulable": F_UNSCHEDULABLE,
+    "NodeName": F_NODE_NAME,
+    "TaintToleration": F_TAINT,
+    "NodeAffinity": F_NODE_AFFINITY,
+    "NodePorts": F_NODE_PORTS,
+    "NodeResourcesFit": F_RESOURCES,
+    "PodTopologySpread": F_SPREAD,
+    "InterPodAffinity": F_POD_AFFINITY,
+}
+
 FILTER_MESSAGES = (
     "node(s) were unschedulable",
     "node(s) didn't match the requested node name",
@@ -409,6 +425,38 @@ def gpu_mask(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
     return jnp.where(is_gpu, feasible, jnp.ones_like(feasible))
 
 
+def gpu_allocate_rowwise(
+    ns: NodeStatic, gpu_free: jnp.ndarray, pod: PodRow
+) -> jnp.ndarray:
+    """gpu_allocate's take, evaluated on EVERY node independently -> f32[N,G].
+
+    Row n is bit-identical to `gpu_allocate(..., onehot=e_n)[0]`: the einsum
+    projection there extracts the row exactly (one 1.0 times f32 values), and
+    every subsequent op here is the same op applied along axis 1."""
+    mem = pod.gpu_mem
+    free_d = gpu_free                                    # [N,G]
+    total_d = ns.gpu_total
+    G = free_d.shape[1]
+
+    elig = (total_d > 0) & (free_d >= mem - _EPS)
+    tight = jnp.argmin(jnp.where(elig, free_d, jnp.inf), axis=1)    # [N]
+    take_single = (
+        (jnp.arange(G)[None, :] == tight[:, None]) & jnp.any(elig, axis=1)[:, None]
+    ).astype(jnp.float32)
+
+    caps = jnp.where(
+        total_d > 0, jnp.floor((free_d + _EPS) / jnp.maximum(mem, 1e-9)), 0.0
+    )
+    prefix = jnp.cumsum(caps, axis=1) - caps
+    take_multi = jnp.clip(pod.gpu_num - prefix, 0.0, caps)
+    take_multi = jnp.where(
+        (jnp.sum(caps, axis=1) >= pod.gpu_num)[:, None], take_multi, 0.0
+    )
+
+    take = jnp.where(pod.gpu_num == 1, take_single, take_multi)
+    return jnp.where((mem > 0) & (pod.gpu_num >= 1), take, 0.0)
+
+
 def gpu_allocate(
     ns: NodeStatic, carry: Carry, pod: PodRow, node_onehot: jnp.ndarray
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -621,25 +669,34 @@ def ports_mask(carry: Carry, pod: PodRow) -> jnp.ndarray:
     return ~jnp.any(conf, axis=0)
 
 
-def ports_commit(carry: Carry, pod: PodRow, onehot: jnp.ndarray):
-    """Record the committed pod's host ports into the selected node's counts.
-    Returns (port_any, port_wild, port_ipc). The HP-sized scatters serialize
-    on device but HP is tiny (max ports per pod)."""
-    sel = onehot.astype(jnp.float32)                           # [N]
+def port_adds(pid_rows: int, pip_rows: int, pod: PodRow):
+    """Per-commit increments to the port count tables for one pod ->
+    (add_any f32[PID], add_wild f32[PID], add_ipc f32[PIP])."""
     active = (pod.hp_pid > 0).astype(jnp.float32)              # [HP]
-    add_any = jnp.zeros(carry.port_any.shape[0], jnp.float32).at[pod.hp_pid].add(
+    add_any = jnp.zeros(pid_rows, jnp.float32).at[pod.hp_pid].add(
         active, mode="drop"
     )
-    add_wild = jnp.zeros(carry.port_wild.shape[0], jnp.float32).at[pod.hp_pid].add(
+    add_wild = jnp.zeros(pid_rows, jnp.float32).at[pod.hp_pid].add(
         active * pod.hp_wild.astype(jnp.float32), mode="drop"
     )
-    add_ipc = jnp.zeros(carry.port_ipc.shape[0], jnp.float32).at[pod.hp_ipid].add(
+    add_ipc = jnp.zeros(pip_rows, jnp.float32).at[pod.hp_ipid].add(
         active * (~pod.hp_wild).astype(jnp.float32) * (pod.hp_ipid > 0), mode="drop"
     )
     # never count into the pad row — keep row 0 identically zero
     add_any = add_any.at[0].set(0.0)
     add_wild = add_wild.at[0].set(0.0)
     add_ipc = add_ipc.at[0].set(0.0)
+    return add_any, add_wild, add_ipc
+
+
+def ports_commit(carry: Carry, pod: PodRow, onehot: jnp.ndarray):
+    """Record the committed pod's host ports into the selected node's counts.
+    Returns (port_any, port_wild, port_ipc). The HP-sized scatters serialize
+    on device but HP is tiny (max ports per pod)."""
+    sel = onehot.astype(jnp.float32)                           # [N]
+    add_any, add_wild, add_ipc = port_adds(
+        carry.port_any.shape[0], carry.port_ipc.shape[0], pod
+    )
     return (
         carry.port_any + add_any[:, None] * sel[None, :],
         carry.port_wild + add_wild[:, None] * sel[None, :],
@@ -660,11 +717,13 @@ def resource_fail(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
     return static_fail | whole_fail
 
 
-def run_filters(ns: NodeStatic, carry: Carry, pod: PodRow):
+def run_filters(ns: NodeStatic, carry: Carry, pod: PodRow, filter_on=None):
     """All filter plugins -> (mask bool[N], first_fail i32[N]).
 
     first_fail is the index of the first failing filter per node (kube stops a
     node's filter chain at the first failure), or NUM_FILTERS when feasible.
+    `filter_on` (bool[NUM_FILTERS] or None = all on) disables filter plugins
+    per the scheduler profile: a disabled filter never fails a node.
     """
     # NodeUnschedulable filter admits pods tolerating the synthetic
     # node.kubernetes.io/unschedulable:NoSchedule taint (plugin parity);
@@ -691,6 +750,8 @@ def run_filters(ns: NodeStatic, carry: Carry, pod: PodRow):
         ],
         axis=1,
     )                                                           # [N,F]
+    if filter_on is not None:
+        fails = fails & filter_on[None, :]
     mask = ~jnp.any(fails, axis=1) & ns.valid
     first_fail = jnp.where(
         jnp.any(fails, axis=1), jnp.argmax(fails, axis=1), NUM_FILTERS
@@ -825,11 +886,8 @@ def score_inter_pod_affinity(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.n
     return jnp.where(any_active, normalized, 0.0)
 
 
-def score_gpu_share(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
-    """Open-Gpu-Share Score (open-gpu-share.go:85-110): the same worst-fit
-    share as Simon but over the node's CURRENT allocatable — where the
-    whole-GPU count dimension is the dynamic allocatable-device count — then
-    min-max normalized by the plugin's own NormalizeScore."""
+def gpu_share_raw(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
+    """Open-Gpu-Share raw score before its NormalizeScore -> f32[N]."""
     req = pod.req[None, :]                                    # [1,R]
     alloc = ns.alloc
     R = alloc.shape[1]
@@ -845,8 +903,15 @@ def score_gpu_share(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
     )
     share = jnp.where(avail < 0, 1.0, share)
     raw = jnp.max(share, axis=1) * 100.0
-    raw = jnp.where(pod.has_req, raw, 100.0)                  # empty req => Max
-    return _minmax_normalize(raw, ns.valid)
+    return jnp.where(pod.has_req, raw, 100.0)                 # empty req => Max
+
+
+def score_gpu_share(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
+    """Open-Gpu-Share Score (open-gpu-share.go:85-110): the same worst-fit
+    share as Simon but over the node's CURRENT allocatable — where the
+    whole-GPU count dimension is the dynamic allocatable-device count — then
+    min-max normalized by the plugin's own NormalizeScore."""
+    return _minmax_normalize(gpu_share_raw(ns, carry, pod), ns.valid)
 
 
 def run_scores(ns: NodeStatic, carry: Carry, pod: PodRow, weights: jnp.ndarray) -> jnp.ndarray:
@@ -872,8 +937,10 @@ def run_scores(ns: NodeStatic, carry: Carry, pod: PodRow, weights: jnp.ndarray) 
 # The scan: sequential commit of a pod batch in one device computation
 # ---------------------------------------------------------------------------
 
-def schedule_step(ns: NodeStatic, weights: jnp.ndarray, carry: Carry, pod: PodRow):
-    mask, first_fail = run_filters(ns, carry, pod)
+def schedule_step(
+    ns: NodeStatic, weights: jnp.ndarray, carry: Carry, pod: PodRow, filter_on=None
+):
+    mask, first_fail = run_filters(ns, carry, pod, filter_on)
     score = run_scores(ns, carry, pod, weights)
     score = jnp.where(mask, score, -jnp.inf)
     node = jnp.argmax(score)  # first max => lowest node index tie-break
@@ -915,7 +982,9 @@ def schedule_step(ns: NodeStatic, weights: jnp.ndarray, carry: Carry, pod: PodRo
 
 
 @functools.partial(jax.jit, static_argnames=())
-def schedule_batch(ns: NodeStatic, carry: Carry, pods: PodRow, weights: jnp.ndarray):
+def schedule_batch(
+    ns: NodeStatic, carry: Carry, pods: PodRow, weights: jnp.ndarray, filter_on=None
+):
     """Schedule a whole PodBatch sequentially on device.
 
     Returns (final_carry, nodes i32[P] (-1 = unschedulable), reasons i32[P,F],
@@ -925,7 +994,7 @@ def schedule_batch(ns: NodeStatic, carry: Carry, pods: PodRow, weights: jnp.ndar
     """
 
     def step(c, pod):
-        return schedule_step(ns, weights, c, pod)
+        return schedule_step(ns, weights, c, pod, filter_on)
 
     final_carry, (nodes, reasons, gpu_take, vg_take, dev_take) = jax.lax.scan(
         step, carry, pods

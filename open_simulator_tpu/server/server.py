@@ -103,6 +103,12 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802
         if self.path == "/healthz":
             self._send(200, {"status": "ok"})
+        elif self.path == "/debug/timings":
+            # the pprof-analog (server.go:152): recent span trees, see
+            # utils/tracing.py
+            from ..utils.tracing import recent_timings
+
+            self._send(200, {"timings": recent_timings()})
         elif self.path == "/test":
             # parity: GET /test returns the literal "test" (server.go:154-156)
             data = b"test"

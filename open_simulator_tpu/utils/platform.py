@@ -23,3 +23,28 @@ def ensure_platform() -> None:
 
     if jax.config.jax_platforms != plat:
         jax.config.update("jax_platforms", plat)
+
+
+def enable_compilation_cache() -> None:
+    """Persist XLA executables across processes (parity concern: the
+    reference binary re-simulates a tweaked cluster interactively in seconds,
+    apply.go:203-216 — repeat `simon apply` runs must not re-pay 30s+ of
+    compilation). Directory override: OSIM_COMPILE_CACHE; empty disables."""
+    path = os.environ.get(
+        "OSIM_COMPILE_CACHE",
+        os.path.join(
+            os.path.expanduser("~"), ".cache", "open-simulator-tpu", "xla"
+        ),
+    )
+    if not path:
+        return
+    try:
+        os.makedirs(path, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache every executable, however fast the compile looked
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass  # cache is an optimization — never fail an entry point over it

@@ -1,0 +1,139 @@
+"""Timing spans + logging: the observability subsystem.
+
+Parity targets:
+  - `utils.LogIfLong` / the 1 s slow-Simulate trace threshold
+    (`/root/reference/pkg/simulator/core.go:72-73`, `simulator.go:511-521`):
+    here every root span that exceeds OSIM_SLOW_TRACE (default 1.0 s) logs its
+    whole subtree at WARNING.
+  - the `LogLevel` env handling (`cmd/simon/simon.go:46-66`): init_logging()
+    maps LogLevel ∈ {debug, info, warn, error} onto the stdlib logger.
+  - per-pod progress output (`simulator.go:311-321`): the engine emits a
+    per-batch progress line at DEBUG (per-pod printing would serialize the
+    batched device path — the batch line carries the same information).
+  - pprof on the server (`pkg/server/server.go:152`): the /debug/timings
+    endpoint serves recent span trees as JSON.
+
+Spans nest via a thread-local stack; finished roots are kept in a bounded
+ring buffer for the server endpoint. Overhead when disabled is two clock
+reads per span — safe to leave in hot host paths (device time is measured
+as host wall time around blocking calls, which is what a user can act on).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import List, Optional
+
+log = logging.getLogger("osim")
+
+SLOW_TRACE_S = float(os.environ.get("OSIM_SLOW_TRACE", "1.0"))
+_HISTORY_MAX = 64
+
+
+class Span:
+    __slots__ = ("name", "start", "end", "children", "meta")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.start = time.time()
+        self.end: Optional[float] = None
+        self.children: List["Span"] = []
+        self.meta: dict = {}
+
+    @property
+    def duration(self) -> float:
+        return (self.end or time.time()) - self.start
+
+    def to_dict(self) -> dict:
+        d = {
+            "name": self.name,
+            "duration_s": round(self.duration, 4),
+        }
+        if self.meta:
+            d["meta"] = self.meta
+        if self.children:
+            d["children"] = [c.to_dict() for c in self.children]
+        return d
+
+    def render(self, indent: int = 0) -> str:
+        lines = [f"{'  ' * indent}{self.name}: {self.duration * 1e3:.1f} ms"
+                 + (f" {self.meta}" if self.meta else "")]
+        for c in self.children:
+            lines.append(c.render(indent + 1))
+        return "\n".join(lines)
+
+
+class _Tracer(threading.local):
+    def __init__(self) -> None:
+        self.stack: List[Span] = []
+
+
+_tracer = _Tracer()
+_history: List[dict] = []
+_history_lock = threading.Lock()
+
+
+@contextmanager
+def span(name: str, **meta):
+    """Time a phase. Nested spans build a tree; when a ROOT span closes it is
+    recorded for /debug/timings, logged at DEBUG, and escalated to WARNING
+    with its full subtree when slower than OSIM_SLOW_TRACE seconds (the
+    LogIfLong analog)."""
+    s = Span(name)
+    if meta:
+        s.meta.update(meta)
+    parent = _tracer.stack[-1] if _tracer.stack else None
+    if parent is not None:
+        parent.children.append(s)
+    _tracer.stack.append(s)
+    try:
+        yield s
+    finally:
+        s.end = time.time()
+        _tracer.stack.pop()
+        if parent is None:
+            with _history_lock:
+                _history.append(s.to_dict())
+                del _history[:-_HISTORY_MAX]
+            if s.duration > SLOW_TRACE_S:
+                log.warning("slow trace (> %.1fs):\n%s", SLOW_TRACE_S, s.render())
+            else:
+                log.debug("trace:\n%s", s.render())
+
+
+def recent_timings() -> List[dict]:
+    """Recent root span trees, oldest first (the /debug/timings payload)."""
+    with _history_lock:
+        return list(_history)
+
+
+def progress(fmt: str, *args) -> None:
+    """Per-batch progress line (the reference's per-pod report.Progress,
+    simulator.go:311-321, lifted to batch granularity)."""
+    log.debug(fmt, *args)
+
+
+_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warn": logging.WARNING,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+}
+
+
+def init_logging(default: str = "info") -> None:
+    """Honor the LogLevel env exactly like cmd/simon/simon.go:46-66 (invalid
+    values fall back to the default, case-insensitive)."""
+    level = _LEVELS.get(os.environ.get("LogLevel", default).strip().lower())
+    if level is None:
+        level = _LEVELS[default]
+    logging.basicConfig(
+        level=level,
+        format="%(asctime)s %(levelname)-7s %(name)s: %(message)s",
+    )
+    log.setLevel(level)

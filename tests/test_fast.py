@@ -1,0 +1,456 @@
+"""Trajectory fast path must be bit-identical to the naive scan.
+
+The oracle is `schedule_batch` (ops/kernels.py) — the sequential
+one-commit-at-a-time semantics of the reference's scheduleOne cycle
+(generic_scheduler.go:131-175). Every scenario checks placements, failure
+reasons, allocation takes AND the final carry (all leaves, exact equality).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from open_simulator_tpu.core.objects import (
+    ANNO_GPU_COUNT_POD,
+    ANNO_GPU_MEM_POD,
+    ANNO_NODE_LOCAL_STORAGE,
+    ANNO_POD_LOCAL_STORAGE,
+    Node,
+    Pod,
+)
+from open_simulator_tpu.ops.encode import (
+    Encoder,
+    encode_nodes,
+    encode_pods,
+    initial_anti_counts,
+    initial_port_counts,
+    initial_selector_counts,
+)
+from open_simulator_tpu.ops.fast import schedule_batch_fast
+from open_simulator_tpu.ops.kernels import schedule_batch, weights_array
+from open_simulator_tpu.ops.state import (
+    carry_from_table,
+    node_static_from_table,
+    pod_rows_from_batch,
+)
+from open_simulator_tpu.ops.tile import tile_pod_batch
+
+
+def _assert_identical(ns, carry0, batch, force_fast=True):
+    """Run oracle + fast path on the same state; demand exact equality."""
+    w = weights_array()
+    rows = pod_rows_from_batch(batch)
+    carry_ref, nodes_ref, reasons_ref, take_ref, vg_ref, dev_ref = schedule_batch(
+        ns, carry0, rows, w
+    )
+    carry_f, nodes_f, reasons_f, take_f, vg_f, dev_f = schedule_batch_fast(
+        ns, carry0, batch, w, force_fast=force_fast
+    )
+    total = int(batch.valid.sum())
+    np.testing.assert_array_equal(np.asarray(nodes_ref)[:total], nodes_f[:total])
+    np.testing.assert_array_equal(np.asarray(reasons_ref)[:total], reasons_f[:total])
+    np.testing.assert_array_equal(np.asarray(take_ref)[:total], take_f[:total])
+    np.testing.assert_array_equal(np.asarray(vg_ref)[:total], vg_f[:total])
+    np.testing.assert_array_equal(np.asarray(dev_ref)[:total], dev_f[:total])
+    # Final carry: bit-identical so subsequent batches diverge nowhere.
+    # The oracle scan also commits the (all-invalid) padding rows — they are
+    # no-ops by construction, so state equality is still exact.
+    for name in carry_ref._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(carry_ref, name)),
+            np.asarray(getattr(carry_f, name)),
+            err_msg=f"carry field {name}",
+        )
+    return nodes_f
+
+
+def _encode(nodes, templates, counts, bound=()):
+    enc = Encoder()
+    enc.register_pods(templates)
+    for pod, _ in bound:
+        enc.register_pods([pod])
+    table = encode_nodes(enc, nodes)
+    batch = tile_pod_batch(encode_pods(enc, templates), counts)
+    ns = node_static_from_table(enc, table)
+    carry = carry_from_table(
+        table,
+        initial_selector_counts(enc, table, list(bound)),
+        port_counts=initial_port_counts(enc, table, list(bound)),
+        anti_counts=initial_anti_counts(enc, table, list(bound)),
+    )
+    return ns, carry, batch
+
+
+def _node(name, cpu="16", mem="32Gi", pods="16", labels=None, taints=None):
+    return Node.from_dict(
+        {
+            "metadata": {
+                "name": name,
+                "labels": {"kubernetes.io/hostname": name, **(labels or {})},
+            },
+            "spec": {"taints": taints or []},
+            "status": {
+                "allocatable": {"cpu": cpu, "memory": mem, "pods": pods}
+            },
+        }
+    )
+
+
+def _pod(name, cpu="500m", mem="512Mi", labels=None, spec_extra=None, anno=None):
+    spec = {
+        "containers": [
+            {"name": "c", "resources": {"requests": {"cpu": cpu, "memory": mem}}}
+        ]
+    }
+    spec.update(spec_extra or {})
+    return Pod.from_dict(
+        {
+            "metadata": {
+                "name": name,
+                "namespace": "fast",
+                "labels": labels or {},
+                "annotations": anno or {},
+            },
+            "spec": spec,
+        }
+    )
+
+
+def test_fast_matches_naive_tiled_mix():
+    """The bench workload: spread + tolerations + selectors, 4 templates."""
+    from bench import build_state
+
+    ns, carry, batch = build_state(24, 400)
+    _assert_identical(ns, carry, batch)
+
+
+def test_fast_triggers_without_force_on_big_groups():
+    """The heuristic itself must pick the fast path for bench-shaped groups
+    (nodes cap at 110 pods; groups of 600 >> 2*J)."""
+    from bench import build_state
+
+    ns, carry, batch = build_state(16, 2400)
+    _assert_identical(ns, carry, batch, force_fast=False)
+
+
+def test_fast_overflow_reasons():
+    """More pods than cluster capacity: the unschedulable tail's failure
+    attribution must match the oracle exactly."""
+    nodes = [_node(f"n-{i}", cpu="4", pods="6") for i in range(6)]
+    zones = [{"topology.kubernetes.io/zone": f"z-{i % 2}"} for i in range(6)]
+    for n, z in zip(nodes, zones):
+        n.meta.labels.update(z)
+    tmpl = _pod(
+        "t",
+        cpu="1",
+        labels={"app": "web"},
+        spec_extra={
+            "topologySpreadConstraints": [
+                {
+                    "maxSkew": 2,
+                    "topologyKey": "topology.kubernetes.io/zone",
+                    "whenUnsatisfiable": "ScheduleAnyway",
+                    "labelSelector": {"matchLabels": {"app": "web"}},
+                }
+            ]
+        },
+    )
+    ns, carry, batch = _encode(nodes, [tmpl], [64])
+    nodes_out = _assert_identical(ns, carry, batch)
+    assert (nodes_out == -1).sum() > 0  # overflow actually happened
+
+
+def test_fast_hard_spread():
+    """DoNotSchedule spread: domains block and unblock as others fill — the
+    carry-coupled mask must replay exactly."""
+    nodes = []
+    for i in range(9):
+        nodes.append(
+            _node(
+                f"n-{i}",
+                cpu="32",
+                pods="20",
+                labels={"topology.kubernetes.io/zone": f"z-{i % 3}"},
+            )
+        )
+    tmpl = _pod(
+        "t",
+        cpu="250m",
+        labels={"app": "spread"},
+        spec_extra={
+            "topologySpreadConstraints": [
+                {
+                    "maxSkew": 1,
+                    "topologyKey": "topology.kubernetes.io/zone",
+                    "whenUnsatisfiable": "DoNotSchedule",
+                    "labelSelector": {"matchLabels": {"app": "spread"}},
+                },
+                {
+                    "maxSkew": 3,
+                    "topologyKey": "kubernetes.io/hostname",
+                    "whenUnsatisfiable": "DoNotSchedule",
+                    "labelSelector": {"matchLabels": {"app": "spread"}},
+                },
+            ]
+        },
+    )
+    ns, carry, batch = _encode(nodes, [tmpl], [120])
+    _assert_identical(ns, carry, batch)
+
+
+def test_fast_required_anti_affinity():
+    """Required anti-affinity by hostname: each node takes exactly one pod;
+    symmetry counts must evolve identically (own_anti path)."""
+    nodes = [_node(f"n-{i}", pods="30") for i in range(8)]
+    tmpl = _pod(
+        "t",
+        cpu="100m",
+        labels={"app": "solo"},
+        spec_extra={
+            "affinity": {
+                "podAntiAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [
+                        {
+                            "labelSelector": {"matchLabels": {"app": "solo"}},
+                            "topologyKey": "kubernetes.io/hostname",
+                        }
+                    ]
+                }
+            }
+        },
+    )
+    other = _pod("o", cpu="100m", labels={"app": "other"})
+    ns, carry, batch = _encode(nodes, [tmpl, other], [24, 24])
+    nodes_out = _assert_identical(ns, carry, batch)
+    assert (nodes_out[:24] >= 0).sum() == 8  # one per node, 16 blocked
+
+
+def test_fast_pod_affinity_zone():
+    """Required pod affinity over zones incl. the first-pod-of-group case."""
+    nodes = [
+        _node(
+            f"n-{i}",
+            pods="30",
+            labels={"topology.kubernetes.io/zone": f"z-{i % 3}"},
+        )
+        for i in range(9)
+    ]
+    tmpl = _pod(
+        "t",
+        cpu="100m",
+        labels={"app": "pack"},
+        spec_extra={
+            "affinity": {
+                "podAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [
+                        {
+                            "labelSelector": {"matchLabels": {"app": "pack"}},
+                            "topologyKey": "topology.kubernetes.io/zone",
+                        }
+                    ]
+                }
+            }
+        },
+    )
+    ns, carry, batch = _encode(nodes, [tmpl], [40])
+    _assert_identical(ns, carry, batch)
+
+
+def test_fast_host_ports():
+    """Host ports: one pod per node, self-conflict afterwards — trajectory
+    port feasibility and reason attribution must match."""
+    nodes = [_node(f"n-{i}", pods="30") for i in range(5)]
+    tmpl = _pod(
+        "t",
+        cpu="100m",
+        spec_extra={
+            "containers": [
+                {
+                    "name": "c",
+                    "resources": {"requests": {"cpu": "100m"}},
+                    "ports": [{"containerPort": 80, "hostPort": 8080}],
+                }
+            ]
+        },
+    )
+    ns, carry, batch = _encode(nodes, [tmpl], [12])
+    nodes_out = _assert_identical(ns, carry, batch)
+    assert (nodes_out >= 0).sum() == 5
+
+
+def test_fast_gpu_share_group():
+    """GPU share packing: per-device free memory is trajectory state; takes
+    (device ids) must match the two-pointer/tightest-fit oracle."""
+    def gpu_node(name, count, per_dev_gib):
+        total = count * per_dev_gib
+        res = {
+            "cpu": "64",
+            "memory": "256Gi",
+            "pods": "110",
+            "alibabacloud.com/gpu-count": str(count),
+            "alibabacloud.com/gpu-mem": f"{total}Gi",
+        }
+        return Node.from_dict(
+            {
+                "metadata": {"name": name},
+                "status": {"allocatable": dict(res), "capacity": dict(res)},
+            }
+        )
+
+    nodes = [gpu_node(f"g-{i}", 4, 16) for i in range(4)]
+    single = _pod(
+        "s", cpu="1", mem="1Gi",
+        anno={ANNO_GPU_MEM_POD: "4Gi", ANNO_GPU_COUNT_POD: "1"},
+    )
+    multi = _pod(
+        "m", cpu="1", mem="1Gi",
+        anno={ANNO_GPU_MEM_POD: "8Gi", ANNO_GPU_COUNT_POD: "2"},
+    )
+    ns, carry, batch = _encode(nodes, [single, multi], [30, 20])
+    nodes_out = _assert_identical(ns, carry, batch)
+    assert (nodes_out >= 0).sum() > 0
+
+
+def test_fast_open_local_storage():
+    """Open-Local: VG binpack consumes trajectory state; vg takes and the
+    final vg_free must match exactly."""
+    def st_node(name, vg_gib):
+        node = _node(name, cpu="32", pods="110")
+        node.meta.annotations[ANNO_NODE_LOCAL_STORAGE] = json.dumps(
+            {
+                "vgs": [
+                    {"name": "pool", "capacity": str(vg_gib << 30), "requested": "0"}
+                ],
+                "devices": [],
+            }
+        )
+        return node
+
+    nodes = [st_node(f"s-{i}", 40 + 10 * i) for i in range(4)]
+    tmpl = _pod(
+        "t", cpu="250m",
+        anno={
+            ANNO_POD_LOCAL_STORAGE: json.dumps(
+                [{"name": "data", "kind": "LVM", "size": str(5 << 30)}]
+            )
+        },
+    )
+    plain = _pod("p", cpu="250m")
+    ns, carry, batch = _encode(nodes, [tmpl, plain], [28, 12])
+    nodes_out = _assert_identical(ns, carry, batch)
+    assert (nodes_out[:28] >= 0).sum() > 0
+
+
+def test_fast_taints_and_selectors():
+    """Static-mask variety: tainted nodes, tolerating group, selector-pinned
+    group, plus bound pods seeding nonzero carry counts."""
+    nodes = []
+    for i in range(8):
+        taints = (
+            [{"key": "dedicated", "value": "batch", "effect": "NoSchedule"}]
+            if i % 2 == 0
+            else []
+        )
+        nodes.append(
+            _node(
+                f"n-{i}",
+                pods="20",
+                labels={"tier": "gold" if i % 3 == 0 else "silver"},
+                taints=taints,
+            )
+        )
+    tol = _pod(
+        "tol", cpu="200m", labels={"app": "b"},
+        spec_extra={
+            "tolerations": [
+                {"key": "dedicated", "operator": "Equal", "value": "batch",
+                 "effect": "NoSchedule"}
+            ]
+        },
+    )
+    pinned = _pod(
+        "pin", cpu="200m", labels={"app": "c"},
+        spec_extra={"nodeSelector": {"tier": "gold"}},
+    )
+    bound_pod = _pod("pre", cpu="1", labels={"app": "b"})
+    bound_pod.node_name = "n-1"
+    ns, carry, batch = _encode(
+        nodes, [tol, pinned], [30, 20], bound=[(bound_pod, "n-1")]
+    )
+    _assert_identical(ns, carry, batch)
+
+
+def test_fast_small_group_falls_back():
+    """Without force_fast, tiny groups must take the grouped path and still
+    be exact (the dispatch itself is under test here)."""
+    nodes = [_node(f"n-{i}") for i in range(4)]
+    tmpl = _pod("t", cpu="250m")
+    ns, carry, batch = _encode(nodes, [tmpl], [10])
+    _assert_identical(ns, carry, batch, force_fast=False)
+
+
+def test_fast_resources_filter_disabled_falls_back():
+    """A profile disabling NodeResourcesFit voids the trajectory bound (the
+    resource filter is what caps per-node commits) — the dispatcher must fall
+    back to the grouped path and stay exact."""
+    import jax.numpy as jnp
+
+    from open_simulator_tpu.ops.kernels import F_RESOURCES, NUM_FILTERS
+
+    nodes = [_node(f"n-{i}", cpu="2", pods="4") for i in range(3)]
+    tmpl = _pod("t", cpu="1")
+    ns, carry, batch = _encode(nodes, [tmpl], [80])
+    fo = np.ones(NUM_FILTERS, bool)
+    fo[F_RESOURCES] = False
+    fo_j = jnp.asarray(fo)
+
+    w = weights_array()
+    rows = pod_rows_from_batch(batch)
+    _, nodes_ref, reasons_ref, *_ = schedule_batch(ns, carry, rows, w, fo_j)
+    _, nodes_f, reasons_f, *_ = schedule_batch_fast(
+        ns, carry, batch, w, force_fast=True, filter_on=fo_j
+    )
+    total = int(batch.valid.sum())
+    np.testing.assert_array_equal(np.asarray(nodes_ref)[:total], nodes_f[:total])
+    np.testing.assert_array_equal(np.asarray(reasons_ref)[:total], reasons_f[:total])
+    # with the filter off, every pod lands despite 3x4 pod slots
+    assert (nodes_f[:total] >= 0).all()
+
+
+def test_fast_filter_disable_parity_when_fast():
+    """Disabling a non-resource filter (NodePorts) keeps the fast path active
+    and bit-identical to the oracle with the same mask."""
+    import jax.numpy as jnp
+
+    from open_simulator_tpu.ops.kernels import F_NODE_PORTS, NUM_FILTERS
+
+    nodes = [_node(f"n-{i}", pods="40") for i in range(4)]
+    tmpl = _pod(
+        "t",
+        cpu="100m",
+        spec_extra={
+            "containers": [
+                {
+                    "name": "c",
+                    "resources": {"requests": {"cpu": "100m"}},
+                    "ports": [{"containerPort": 80, "hostPort": 8080}],
+                }
+            ]
+        },
+    )
+    ns, carry, batch = _encode(nodes, [tmpl], [20])
+    fo = np.ones(NUM_FILTERS, bool)
+    fo[F_NODE_PORTS] = False
+    fo_j = jnp.asarray(fo)
+
+    w = weights_array()
+    rows = pod_rows_from_batch(batch)
+    _, nodes_ref, reasons_ref, *_ = schedule_batch(ns, carry, rows, w, fo_j)
+    _, nodes_f, reasons_f, *_ = schedule_batch_fast(
+        ns, carry, batch, w, force_fast=True, filter_on=fo_j
+    )
+    total = int(batch.valid.sum())
+    np.testing.assert_array_equal(np.asarray(nodes_ref)[:total], nodes_f[:total])
+    np.testing.assert_array_equal(np.asarray(reasons_ref)[:total], reasons_f[:total])
+    assert (nodes_f[:total] >= 0).all()  # port conflicts no longer filter

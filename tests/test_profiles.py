@@ -110,3 +110,178 @@ def test_weights_affect_placement():
     pack_result = simulate(cluster, apps, weights=pack_weights)
     pack_nodes = {st.node.name for st in pack_result.node_status if st.pods}
     assert len(pack_nodes) == 1  # worst-fit-only packs one node
+
+
+def test_filter_disable_changes_placements(tmp_path):
+    """Disabling the PodTopologySpread *filter* plugin lets a DoNotSchedule
+    constraint overflow a domain (utils.go:304-381 builds the Filter set;
+    disabled in-tree filters must actually stop filtering)."""
+    from open_simulator_tpu.core.objects import Node
+    from open_simulator_tpu.engine.simulator import (
+        AppResource,
+        ClusterResource,
+        simulate,
+    )
+    from open_simulator_tpu.models.profiles import load_scheduler_config
+
+    nodes = [
+        Node.from_dict(
+            {
+                "metadata": {
+                    "name": f"n{i}",
+                    "labels": {
+                        "kubernetes.io/hostname": f"n{i}",
+                        "topology.kubernetes.io/zone": "z0" if i == 0 else "z1",
+                    },
+                },
+                "status": {"allocatable": {"cpu": "4" if i == 0 else "64",
+                                           "memory": "64Gi", "pods": "110"}},
+            }
+        )
+        for i in range(2)
+    ]
+    deploy = {
+        "kind": "Deployment",
+        "metadata": {"name": "d", "namespace": "x"},
+        "spec": {
+            "replicas": 10,
+            "template": {
+                "metadata": {"labels": {"app": "d"}},
+                "spec": {
+                    "containers": [
+                        {"name": "c", "image": "i",
+                         "resources": {"requests": {"cpu": "1"}}}
+                    ],
+                    "topologySpreadConstraints": [
+                        {
+                            "maxSkew": 1,
+                            "topologyKey": "topology.kubernetes.io/zone",
+                            "whenUnsatisfiable": "DoNotSchedule",
+                            "labelSelector": {"matchLabels": {"app": "d"}},
+                        }
+                    ],
+                },
+            },
+        },
+    }
+    cluster = ClusterResource(nodes=nodes)
+    apps = [AppResource(name="a", objects=[deploy])]
+
+    strict = simulate(cluster, apps)
+    # zone z0 caps at 4 cpu -> skew 1 blocks z1 beyond 5; some pods fail
+    assert strict.unscheduled
+
+    cfg = tmp_path / "sched.yaml"
+    cfg.write_text(
+        """
+kind: KubeSchedulerConfiguration
+profiles:
+  - plugins:
+      filter:
+        disabled:
+          - name: PodTopologySpread
+"""
+    )
+    profiles = load_scheduler_config(str(cfg)).profiles
+    assert profiles[0].filter_on_array() is not None
+    relaxed = simulate(cluster, apps, profiles=profiles)
+    assert not relaxed.unscheduled  # overflow allowed once the filter is off
+
+
+def test_multi_profile_by_scheduler_name(tmp_path):
+    """Pods pick their profile by spec.schedulerName (WithProfiles parity,
+    simulator.go:209); unknown names fail with an explicit reason."""
+    from open_simulator_tpu.core.objects import Node
+    from open_simulator_tpu.engine.simulator import (
+        AppResource,
+        ClusterResource,
+        simulate,
+    )
+    from open_simulator_tpu.models.profiles import load_scheduler_config
+
+    nodes = [
+        Node.from_dict(
+            {
+                "metadata": {"name": f"n{i}",
+                             "labels": {"kubernetes.io/hostname": f"n{i}"}},
+                "status": {"allocatable": {"cpu": "16", "memory": "32Gi",
+                                           "pods": "110"}},
+            }
+        )
+        for i in range(4)
+    ]
+
+    def deploy(name, sched=None, replicas=8):
+        spec = {
+            "containers": [
+                {"name": "c", "image": "i",
+                 "resources": {"requests": {"cpu": "1", "memory": "1Gi"}}}
+            ]
+        }
+        if sched:
+            spec["schedulerName"] = sched
+        return {
+            "kind": "Deployment",
+            "metadata": {"name": name, "namespace": "x"},
+            "spec": {
+                "replicas": replicas,
+                "template": {"metadata": {"labels": {"app": name}},
+                             "spec": spec},
+            },
+        }
+
+    cfg = tmp_path / "sched.yaml"
+    cfg.write_text(
+        """
+kind: KubeSchedulerConfiguration
+profiles:
+  - schedulerName: default-scheduler
+  - schedulerName: packer
+    plugins:
+      score:
+        disabled: [{name: "*"}]
+        enabled: [{name: Simon, weight: 100}]
+"""
+    )
+    profiles = load_scheduler_config(str(cfg)).profiles
+    assert len(profiles) == 2
+
+    cluster = ClusterResource(nodes=nodes)
+    apps = [
+        AppResource(name="a", objects=[deploy("spready")]),
+        AppResource(name="b", objects=[deploy("packy", sched="packer")]),
+        AppResource(name="c", objects=[deploy("lost", sched="nobody", replicas=1)]),
+    ]
+    res = simulate(cluster, apps, profiles=profiles)
+    # the unknown-scheduler pod fails loudly
+    assert len(res.unscheduled) == 1
+    assert "nobody" in res.unscheduled[0].reason
+    # packer profile (worst-fit only) packs its pods onto one node;
+    # the default profile spreads its own
+    packy_nodes = {
+        st.node.name
+        for st in res.node_status
+        for p in st.pods
+        if p.meta.labels.get("app") == "packy"
+    }
+    spready_nodes = {
+        st.node.name
+        for st in res.node_status
+        for p in st.pods
+        if p.meta.labels.get("app") == "spready"
+    }
+    assert len(packy_nodes) == 1
+    assert len(spready_nodes) == 4
+
+
+def test_extenders_rejected(tmp_path):
+    cfg = tmp_path / "sched.yaml"
+    cfg.write_text(
+        """
+kind: KubeSchedulerConfiguration
+extenders:
+  - urlPrefix: http://127.0.0.1:8888/
+"""
+    )
+    with pytest.raises(ValueError, match="extenders"):
+        load_scheduler_config(str(cfg))

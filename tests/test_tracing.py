@@ -1,0 +1,100 @@
+"""Observability subsystem: spans, LogLevel, slow-trace, /debug/timings."""
+
+import json
+import logging
+import threading
+import urllib.request
+
+from open_simulator_tpu.utils import tracing
+
+
+def test_span_nesting_and_history():
+    with tracing.span("root", kind="test") as root:
+        with tracing.span("child"):
+            pass
+        with tracing.span("child2"):
+            pass
+    assert [c.name for c in root.children] == ["child", "child2"]
+    latest = tracing.recent_timings()[-1]
+    assert latest["name"] == "root"
+    assert latest["meta"] == {"kind": "test"}
+    assert [c["name"] for c in latest["children"]] == ["child", "child2"]
+
+
+def test_slow_trace_logs_warning(monkeypatch, caplog):
+    monkeypatch.setattr(tracing, "SLOW_TRACE_S", 0.0)
+    with caplog.at_level(logging.WARNING, logger="osim"):
+        with tracing.span("slowroot"):
+            pass
+    assert any("slow trace" in r.message for r in caplog.records)
+    assert any("slowroot" in r.getMessage() for r in caplog.records)
+
+
+def test_init_logging_loglevel_env(monkeypatch):
+    monkeypatch.setenv("LogLevel", "debug")
+    tracing.init_logging()
+    assert tracing.log.level == logging.DEBUG
+    monkeypatch.setenv("LogLevel", "bogus")
+    tracing.init_logging()
+    assert tracing.log.level == logging.INFO
+
+
+def test_simulate_emits_spans():
+    from open_simulator_tpu.core.objects import Node
+    from open_simulator_tpu.engine.simulator import (
+        AppResource,
+        ClusterResource,
+        simulate,
+    )
+
+    nodes = [
+        Node.from_dict(
+            {
+                "metadata": {"name": "n0", "labels": {"kubernetes.io/hostname": "n0"}},
+                "status": {"allocatable": {"cpu": "4", "memory": "8Gi", "pods": "10"}},
+            }
+        )
+    ]
+    deploy = {
+        "kind": "Deployment",
+        "metadata": {"name": "d", "namespace": "x"},
+        "spec": {
+            "replicas": 2,
+            "template": {
+                "metadata": {"labels": {"app": "d"}},
+                "spec": {
+                    "containers": [
+                        {"name": "c", "image": "i",
+                         "resources": {"requests": {"cpu": "1"}}}
+                    ]
+                },
+            },
+        },
+    }
+    simulate(ClusterResource(nodes=nodes), [AppResource(name="a", objects=[deploy])])
+    roots = tracing.recent_timings()
+    sim = [r for r in roots if r["name"] == "simulate"][-1]
+    child_names = [c["name"] for c in sim["children"]]
+    assert "expand-workloads" in child_names
+    assert "encode-cluster" in child_names
+    assert "decode-result" in child_names
+
+
+def test_server_debug_timings_endpoint():
+    from open_simulator_tpu.server.server import make_server
+
+    httpd = make_server(0)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        with tracing.span("server-visible"):
+            pass
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/timings", timeout=5
+        ) as resp:
+            payload = json.loads(resp.read())
+        assert any(r["name"] == "server-visible" for r in payload["timings"])
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
