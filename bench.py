@@ -192,11 +192,266 @@ def build_state(n_nodes: int, n_pods: int):
     return ns, carry, batch
 
 
+# ---------------------------------------------------------------------------
+# The five BASELINE.json configs, driven END-TO-END through the product
+# engine (simulate()/plan_capacity — workload expansion, validation, encode,
+# compile and decode all included in the reported wall).
+# ---------------------------------------------------------------------------
+
+def _mk_node(name, cpu, mem, pods="110", labels=None, capacity_extra=None):
+    from open_simulator_tpu.core.objects import Node
+
+    res = {"cpu": cpu, "memory": mem, "pods": pods}
+    if capacity_extra:
+        res.update(capacity_extra)
+    return Node.from_dict(
+        {
+            "metadata": {
+                "name": name,
+                "labels": {"kubernetes.io/hostname": name, **(labels or {})},
+            },
+            "status": {"allocatable": dict(res), "capacity": dict(res)},
+        }
+    )
+
+
+def _mk_deploy(name, replicas, cpu, mem, labels=None, spec_extra=None, anno=None):
+    spec = {
+        "containers": [
+            {"name": "c", "image": "img",
+             "resources": {"requests": {"cpu": cpu, "memory": mem}}}
+        ]
+    }
+    spec.update(spec_extra or {})
+    return {
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": "bench"},
+        "spec": {
+            "replicas": replicas,
+            "template": {
+                "metadata": {
+                    "labels": {"app": name, **(labels or {})},
+                    "annotations": anno or {},
+                },
+                "spec": spec,
+            },
+        },
+    }
+
+
+def config_stock():
+    """Config 1: the reference's stock demo_1 sample (cluster + 5 apps + the
+    add-node capacity search), through the full Applier."""
+    import io
+
+    from open_simulator_tpu.api.config import AppInConfig, SimonConfig
+    from open_simulator_tpu.engine.apply import run_apply
+
+    ref = "/root/reference/example"
+    cfg = SimonConfig(
+        custom_config=f"{ref}/cluster/demo_1",
+        new_node=f"{ref}/newnode/demo_1",
+        app_list=[
+            AppInConfig(
+                name="yoda", path=f"{ref}/application/charts/yoda", chart=True
+            ),
+            AppInConfig(name="simple", path=f"{ref}/application/simple"),
+            AppInConfig(name="complicated", path=f"{ref}/application/complicate"),
+            AppInConfig(name="open_local", path=f"{ref}/application/open_local"),
+            AppInConfig(name="more_pods", path=f"{ref}/application/more_pods"),
+        ],
+    )
+    t0 = time.time()
+    outcome = run_apply(cfg, out=io.StringIO())
+    wall = time.time() - t0
+    added = outcome.plan.nodes_added if outcome.plan else 0
+    return {
+        "wall_s": round(wall, 2),
+        "nodes_added": added,
+        "unscheduled": len(outcome.result.unscheduled),
+    }
+
+
+def _simulate_config(nodes, deploys):
+    from open_simulator_tpu.engine.simulator import (
+        AppResource,
+        ClusterResource,
+        simulate,
+    )
+
+    t0 = time.time()
+    result = simulate(
+        ClusterResource(nodes=nodes),
+        [AppResource(name="bench", objects=deploys)],
+    )
+    wall = time.time() - t0
+    placed = sum(len(st.pods) for st in result.node_status)
+    return wall, placed, len(result.unscheduled)
+
+
+def config_fit(n_pods=1_000, n_nodes=100):
+    """Config 2: NodeResourcesFit-only bin-packing, 1k pods x 100 nodes."""
+    nodes = [_mk_node(f"n-{i}", "32", "64Gi") for i in range(n_nodes)]
+    deploys = [
+        _mk_deploy("web", n_pods // 2, "500m", "1Gi"),
+        _mk_deploy("api", n_pods - n_pods // 2, "1", "2Gi"),
+    ]
+    wall, placed, unsched = _simulate_config(nodes, deploys)
+    return {
+        "wall_s": round(wall, 2),
+        "value": round(n_pods / wall, 1),
+        "scheduled": placed,
+        "unscheduled": unsched,
+    }
+
+
+def config_spread_affinity(n_pods=10_000, n_nodes=1_000):
+    """Config 3: PodTopologySpread + InterPodAffinity, 10k pods x 1k nodes
+    across 3 zones."""
+    nodes = [
+        _mk_node(
+            f"n-{i}", "32", "64Gi",
+            labels={"topology.kubernetes.io/zone": f"az-{i % 3}"},
+        )
+        for i in range(n_nodes)
+    ]
+    spread = {
+        "topologySpreadConstraints": [
+            {
+                "maxSkew": 50,
+                "topologyKey": "topology.kubernetes.io/zone",
+                "whenUnsatisfiable": "DoNotSchedule",
+                "labelSelector": {"matchLabels": {"app": "front"}},
+            }
+        ]
+    }
+    affinity = {
+        "affinity": {
+            "podAffinity": {
+                "preferredDuringSchedulingIgnoredDuringExecution": [
+                    {
+                        "weight": 10,
+                        "podAffinityTerm": {
+                            "labelSelector": {"matchLabels": {"app": "front"}},
+                            "topologyKey": "topology.kubernetes.io/zone",
+                        },
+                    }
+                ]
+            }
+        }
+    }
+    deploys = [
+        _mk_deploy("front", n_pods // 2, "250m", "512Mi", spec_extra=spread),
+        _mk_deploy("back", n_pods - n_pods // 2, "500m", "1Gi",
+                   spec_extra=affinity),
+    ]
+    wall, placed, unsched = _simulate_config(nodes, deploys)
+    return {
+        "wall_s": round(wall, 2),
+        "value": round(n_pods / wall, 1),
+        "scheduled": placed,
+        "unscheduled": unsched,
+    }
+
+
+def config_gpushare(n_pods=5_000, n_nodes=320):
+    """Config 4: the gpushare example shape scaled to 5k GPU pods (8x16GiB
+    devices per node, mixed 4/8 GiB share requests)."""
+    gpu_extra = {
+        "alibabacloud.com/gpu-count": "8",
+        "alibabacloud.com/gpu-mem": "128Gi",
+    }
+    nodes = [
+        _mk_node(f"g-{i}", "64", "256Gi", capacity_extra=gpu_extra)
+        for i in range(n_nodes)
+    ]
+    deploys = [
+        _mk_deploy(
+            "train", n_pods // 2, "2", "8Gi",
+            anno={"alibabacloud.com/gpu-mem": "8Gi",
+                  "alibabacloud.com/gpu-count": "1"},
+        ),
+        _mk_deploy(
+            "infer", n_pods - n_pods // 2, "1", "4Gi",
+            anno={"alibabacloud.com/gpu-mem": "4Gi",
+                  "alibabacloud.com/gpu-count": "1"},
+        ),
+    ]
+    wall, placed, unsched = _simulate_config(nodes, deploys)
+    return {
+        "wall_s": round(wall, 2),
+        "value": round(n_pods / wall, 1),
+        "scheduled": placed,
+        "unscheduled": unsched,
+    }
+
+
+def config_plan(n_pods=100_000, n_nodes=10_000):
+    """Config 5 — the north star: full capacity plan, 100k pods onto a
+    10k-node cluster sized so the workload overflows and the add-node search
+    must run. Wall includes workload expansion, validation, encode, all
+    probe simulations and every compile."""
+    from open_simulator_tpu.engine.capacity import plan_capacity
+    from open_simulator_tpu.engine.simulator import (
+        AppResource,
+        ClusterResource,
+    )
+
+    nodes = [
+        _mk_node(
+            f"n-{i}", "16", "32Gi",
+            labels={"topology.kubernetes.io/zone": f"az-{i % 3}"},
+        )
+        for i in range(n_nodes)
+    ]
+    spread = {
+        "topologySpreadConstraints": [
+            {
+                "maxSkew": 50,
+                "topologyKey": "topology.kubernetes.io/zone",
+                "whenUnsatisfiable": "ScheduleAnyway",
+                "labelSelector": {"matchLabels": {"app": "web"}},
+            }
+        ]
+    }
+    deploys = [
+        _mk_deploy("web", n_pods // 2, "500m", "1Gi", spec_extra=spread),
+        _mk_deploy("batch", n_pods - n_pods // 2, "250m", "512Mi"),
+    ]
+    template = _mk_node("new-node", "32", "64Gi")
+    cluster = ClusterResource(nodes=nodes)
+    apps = [AppResource(name="bench", objects=deploys)]
+    t0 = time.time()
+    plan = plan_capacity(cluster, apps, template)
+    wall = time.time() - t0
+    return {
+        "wall_s": round(wall, 2),
+        "value": round(n_pods / wall, 1),
+        "nodes_added": plan.nodes_added if plan else -1,
+        "attempts": plan.attempts if plan else 0,
+        "under_60s": wall < 60.0,
+    }
+
+
+CONFIGS = {
+    "stock": config_stock,
+    "fit_1k_100n": config_fit,
+    "spread_aff_10k_1k": config_spread_affinity,
+    "gpushare_5k": config_gpushare,
+    "plan_100k_10k": config_plan,
+}
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--pods", type=int, default=100_000)
     parser.add_argument("--nodes", type=int, default=10_000)
     parser.add_argument("--quick", action="store_true", help="tiny smoke sizes")
+    parser.add_argument(
+        "--configs", default="all",
+        help="comma list of end-to-end configs to run alongside the headline "
+        f"kernel benchmark ({', '.join(CONFIGS)}), 'all', or 'none'",
+    )
     args = parser.parse_args()
     if args.quick:
         args.pods, args.nodes = 2_000, 200
@@ -248,6 +503,37 @@ def main() -> int:
         "device": str(jax.devices()[0]),
     }
     result.update(backend_info)
+
+    # End-to-end BASELINE configs (through simulate()/run_apply/plan_capacity;
+    # wall includes expansion, validation, encode, compile and decode).
+    # Progress lines go to stderr; the single stdout JSON line stays the
+    # driver contract, carrying the per-config results under "configs".
+    if args.configs in ("none", "all"):
+        wanted = [] if args.configs == "none" else list(CONFIGS)
+    else:
+        wanted = [c.strip() for c in args.configs.split(",") if c.strip()]
+        unknown = [c for c in wanted if c not in CONFIGS]
+        if unknown:
+            parser.error(
+                f"--configs: unknown config(s) {unknown}; "
+                f"choose from {', '.join(CONFIGS)}, all, none"
+            )
+    if args.quick:
+        wanted = []
+    if wanted:
+        configs_out = {}
+        for name in wanted:
+            print(f"bench config {name}...", file=sys.stderr, flush=True)
+            try:
+                configs_out[name] = CONFIGS[name]()
+            except Exception as e:  # a broken config must not kill the bench
+                configs_out[name] = {"error": f"{type(e).__name__}: {e}"}
+            print(
+                f"bench config {name}: {json.dumps(configs_out[name])}",
+                file=sys.stderr, flush=True,
+            )
+        result["configs"] = configs_out
+
     print(json.dumps(result))
     return 0
 

@@ -170,16 +170,24 @@ def select_victims_on_node(
     bound: Sequence[Pod],
     pdbs: Sequence[PodDisruptionBudget],
     pdb_allowed: Dict[int, int],
+    fits_fn=None,
 ) -> Optional[PreemptionResult]:
     """selectVictimsOnNode (:578). `pdb_allowed` maps pdb index -> remaining
     DisruptionsAllowed (shared across the node loop the way the reference
     recomputes per node from status — budgets here are per-candidate, so pass
-    a copy)."""
+    a copy).
+
+    `fits_fn(pod, node, remaining) -> bool` overrides the host-side
+    resources-only fit model; the engine passes the device filter kernel
+    (Simulator._device_fits) so victim selection sees the FULL filter set —
+    spread/affinity/storage/GPU/ports — exactly like the reference's dry-run
+    of the filter plugins on the post-eviction node (:598-626)."""
+    fits = fits_fn or _fits
     potential = [p for p in bound if p.priority < pod.priority]
     if not potential:
         return None
     keep = [p for p in bound if p.priority >= pod.priority]
-    if not _fits(pod, node, keep):
+    if not fits(pod, node, keep):
         return None
 
     potential.sort(key=_more_important)
@@ -202,7 +210,7 @@ def select_victims_on_node(
 
     def reprieve(p: Pod) -> bool:
         remaining.append(p)
-        if _fits(pod, node, remaining):
+        if fits(pod, node, remaining):
             return True
         remaining.pop()
         victims.append(p)
@@ -249,6 +257,7 @@ def try_preempt(
     nodes: Sequence[Node],
     bound_by_node: Dict[str, List[Pod]],
     pdbs: Sequence[PodDisruptionBudget],
+    fits_fn=None,
 ) -> Optional[PreemptionResult]:
     """Full PostFilter: find the best node + minimal victim set, or None."""
     if pod.preemption_policy == "Never":
@@ -264,7 +273,8 @@ def try_preempt(
         if not _static_unresolvable_ok(pod, node):
             continue
         res = select_victims_on_node(
-            pod, node, bound_by_node.get(node.name, []), pdbs, pdb_allowed
+            pod, node, bound_by_node.get(node.name, []), pdbs, pdb_allowed,
+            fits_fn=fits_fn,
         )
         if res is not None:
             candidates.append(res)

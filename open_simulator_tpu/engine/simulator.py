@@ -164,6 +164,8 @@ class Simulator:
         mesh=None,
         n_pad: Optional[int] = None,
         profiles=None,
+        plugins=None,
+        patch_pods=None,
     ) -> None:
         """`mesh` (jax.sharding.Mesh or None): when set, the node axis of the
         cluster state is sharded across the mesh devices and the same grouped
@@ -178,6 +180,15 @@ class Simulator:
         # a bisection bracket to the SAME bucket so XLA compiles once for the
         # whole search (padded rows are valid=False and inert).
         self.n_pad = n_pad
+        # Out-of-tree device plugins (plugins.DevicePlugin; the extraRegistry
+        # analog, simulator.go:190-203).
+        from ..plugins import split_registry
+
+        self._extra_filters, self._extra_scores = split_registry(plugins or ())
+        # Per-workload-kind pod mutation hooks (WithPatchPodsFuncMap parity,
+        # simulator.go:243-249,471-500): kind -> fn(List[Pod]) applied to
+        # every pod list generated from that workload kind.
+        self._patch_pods = dict(patch_pods or {})
         # Apiserver-grade validation before anything schedules: the reference
         # validates every imported node and synthesized pod and fails the
         # whole Simulate on the first invalid object (utils.go:495-508).
@@ -233,7 +244,9 @@ class Simulator:
                 )
         # Cluster daemonsets expand against the final node list (core.go:85-96).
         for ds in cluster.daemonsets:
-            self._pending_cluster.extend(pods_from_workload(ds, nodes=cluster.nodes))
+            ds_pods = pods_from_workload(ds, nodes=cluster.nodes)
+            self._apply_patch_hooks("DaemonSet", ds_pods)
+            self._pending_cluster.extend(ds_pods)
         self._table = None
         self._ns = None
         self._carry = None
@@ -344,6 +357,8 @@ class Simulator:
             ) = schedule_batch_fast(
                 self._ns, self._carry, batch, weights,
                 filter_on=None if filter_on is None else jnp.asarray(filter_on),
+                extra_filters=self._extra_filters,
+                extra_scores=self._extra_scores,
             )
             scheduled = int((placed_np >= 0).sum())
             sp.meta["scheduled"] = scheduled
@@ -384,6 +399,122 @@ class Simulator:
         return failed
 
     # -- preemption (PostFilter) -------------------------------------------
+    def _device_fits(self):
+        """fits_fn for victim selection that runs the REAL filter kernel on
+        the candidate node's post-eviction state (parity:
+        selectVictimsOnNode's dry run of the filter plugins,
+        default_preemption.go:598-626) instead of the resources-only host
+        model. One small device call per (node, victim-set) probe — the
+        preemption path is rare, so the round trips are cheap relative to a
+        wrong victim choice + rollback."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.encode import encode_pods, match_vector, resource_scale
+        from ..ops.kernels import run_filters
+        from ..ops.state import pod_rows_from_batch
+
+        if not hasattr(self, "_probe_fit_jit"):
+            extra_filters = self._extra_filters
+
+            @jax.jit
+            def probe_fit(ns, carry, row, ni, cols, filter_on):
+                carry2 = carry._replace(
+                    free=carry.free.at[ni].set(cols["free"]),
+                    sel_counts=carry.sel_counts.at[:, ni].set(cols["sel"]),
+                    gpu_free=carry.gpu_free.at[ni].set(cols["gpu"]),
+                    vg_free=carry.vg_free.at[ni].set(cols["vg"]),
+                    dev_free=carry.dev_free.at[ni].set(cols["dev"]),
+                    port_any=carry.port_any.at[:, ni].set(cols["port_any"]),
+                    port_wild=carry.port_wild.at[:, ni].set(cols["port_wild"]),
+                    port_ipc=carry.port_ipc.at[:, ni].set(cols["port_ipc"]),
+                    anti_counts=carry.anti_counts.at[:, ni].set(cols["anti"]),
+                )
+                # same filter set the pod's profile schedules with (mask +
+                # out-of-tree plugins) — a disabled filter must not veto a
+                # node here either
+                mask, _ = run_filters(ns, carry2, row, filter_on, extra_filters)
+                return mask[ni]
+
+            self._probe_fit_jit = probe_fit
+
+        row_cache: Dict[str, object] = {}
+
+        name_index = {name: i for i, name in enumerate(self._table.names)}
+
+        def fits(pod: Pod, node, remaining) -> bool:
+            ni = name_index[node.name]
+            prof = self._profiles.get(pod.scheduler_name)
+            fo = prof[1] if prof is not None else None
+            fo = (
+                jnp.ones(len(FILTER_MESSAGES), bool)
+                if fo is None
+                else jnp.asarray(fo)
+            )
+            row = row_cache.get(pod.key)
+            if row is None:
+                batch = encode_pods(self.enc, [pod])
+                row = jax.tree.map(
+                    lambda a: a[0], pod_rows_from_batch(batch)
+                )
+                row_cache[pod.key] = row
+            # Node column with ONLY `remaining` of the node's bound pods:
+            # start from the current carry column and reverse the
+            # contributions of the pods being hypothetically evicted.
+            on_node = [
+                p for p, name in self._bound if name == node.name
+            ]
+            keep_ids = {id(p) for p in remaining}
+            cols = {
+                "free": np.asarray(self._carry.free[ni]).copy(),
+                "sel": np.asarray(self._carry.sel_counts[:, ni]).copy(),
+                "gpu": np.asarray(self._carry.gpu_free[ni]).copy(),
+                "vg": np.asarray(self._carry.vg_free[ni]).copy(),
+                "dev": np.asarray(self._carry.dev_free[ni]).copy(),
+                "port_any": np.asarray(self._carry.port_any[:, ni]).copy(),
+                "port_wild": np.asarray(self._carry.port_wild[:, ni]).copy(),
+                "port_ipc": np.asarray(self._carry.port_ipc[:, ni]).copy(),
+                "anti": np.asarray(self._carry.anti_counts[:, ni]).copy(),
+            }
+            for v in on_node:
+                if id(v) in keep_ids:
+                    continue
+                for res, q in v.requests.items():
+                    if res in self.enc.resources:
+                        r = self.enc.resources.index(res)
+                        cols["free"][r] += q / resource_scale(res)
+                cols["free"][self.enc.resources.index("pods")] += 1.0
+                vec = match_vector(self.enc, v)
+                m = min(vec.shape[0], cols["sel"].shape[0])
+                cols["sel"][:m] -= vec[:m]  # evicted pod no longer counts
+                mem = v.gpu_mem_request()
+                if mem > 0:
+                    for d in v.gpu_index_ids():
+                        if 0 <= d < cols["gpu"].shape[0]:
+                            cols["gpu"][d] += np.float32(mem / float(1 << 20))
+                takes = self._storage_takes.get(v.key)
+                if takes is not None:
+                    cols["vg"][: takes[0].shape[0]] += takes[0]
+                    cols["dev"][: takes[1].shape[0]] += takes[1]
+                for pid, wild, ipid in self.enc.port_ids(v):
+                    if pid < cols["port_any"].shape[0]:
+                        cols["port_any"][pid] -= 1.0
+                        if wild:
+                            cols["port_wild"][pid] -= 1.0
+                    if not wild and ipid < cols["port_ipc"].shape[0]:
+                        cols["port_ipc"][ipid] -= 1.0
+                for aid in self.enc.anti_ids(v):
+                    if aid < cols["anti"].shape[0]:
+                        cols["anti"][aid] -= 1.0
+            return bool(
+                self._probe_fit_jit(
+                    self._ns, self._carry, row, ni,
+                    {k: jnp.asarray(v) for k, v in cols.items()}, fo,
+                )
+            )
+
+        return fits
+
     def _try_preemptions(
         self, failed: List[UnscheduledPod]
     ) -> List[UnscheduledPod]:
@@ -405,7 +536,10 @@ class Simulator:
                 bound_by_node = {}
                 for p, node_name in self._bound:
                     bound_by_node.setdefault(node_name, []).append(p)
-            res = try_preempt(pod, self.cluster.nodes, bound_by_node, self._pdbs)
+            res = try_preempt(
+                pod, self.cluster.nodes, bound_by_node, self._pdbs,
+                fits_fn=self._device_fits(),
+            )
             if res is None or not res.victims:
                 still_failed.append(u)
                 continue
@@ -461,15 +595,17 @@ class Simulator:
         anti = np.asarray(self._carry.anti_counts).copy()
         from ..ops.encode import resource_scale
 
+        from ..ops.encode import match_vector
+
         for v in victims:
             for res, q in v.requests.items():
                 r = self.enc.resources.index(res) if res in self.enc.resources else -1
                 if r >= 0:
                     free[ni, r] += q / resource_scale(res)
             free[ni, self.enc.resources.index("pods")] += 1.0
-            for s, entry in enumerate(self.enc.selectors):
-                if s < sel.shape[0] and entry.matches(v):
-                    sel[s, ni] -= 1.0
+            vec = match_vector(self.enc, v)
+            m = min(vec.shape[0], sel.shape[0])
+            sel[:m, ni] -= vec[:m]
             mem = v.gpu_mem_request()
             if mem > 0:
                 for d in v.gpu_index_ids():
@@ -500,6 +636,14 @@ class Simulator:
         )
         self._reshard()
 
+    def _apply_patch_hooks(self, kind: str, pods: List[Pod]) -> None:
+        """WithPatchPodsFuncMap parity (simulator.go:243-249,471-500): let the
+        caller mutate the pods generated from each workload kind before they
+        are validated/ordered/scheduled."""
+        hook = self._patch_pods.get(kind)
+        if hook is not None and pods:
+            hook(pods)
+
     def _order(self, pods: List[Pod]) -> List[Pod]:
         return order_pods(pods, self.cluster.nodes, use_greed=self.use_greed)
 
@@ -515,9 +659,11 @@ class Simulator:
                     for obj in app.objects:
                         kind = obj.get("kind", "")
                         if kind in WORKLOAD_KINDS:
-                            pods.extend(
-                                pods_from_workload(obj, nodes=self.cluster.nodes)
+                            wl_pods = pods_from_workload(
+                                obj, nodes=self.cluster.nodes
                             )
+                            self._apply_patch_hooks(kind, wl_pods)
+                            pods.extend(wl_pods)
                     check_pods(pods, where=f"app {app.name}")
                     app_pods.append(self._order(pods))
 
@@ -600,9 +746,15 @@ def simulate(
     mesh=None,
     n_pad: Optional[int] = None,
     profiles=None,
+    plugins=None,
+    patch_pods=None,
 ) -> SimulateResult:
-    """One-shot simulation (parity: simulator.Simulate, core.go:67-119)."""
+    """One-shot simulation (parity: simulator.Simulate, core.go:67-119).
+
+    `plugins`: out-of-tree DevicePlugin registry (plugins/__init__.py).
+    `patch_pods`: {workload kind: fn(List[Pod])} mutation hooks applied to
+    generated pods (WithPatchPodsFuncMap parity)."""
     return Simulator(
         cluster, weights=weights, use_greed=use_greed, mesh=mesh, n_pad=n_pad,
-        profiles=profiles,
+        profiles=profiles, plugins=plugins, patch_pods=patch_pods,
     ).run(apps)

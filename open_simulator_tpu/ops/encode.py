@@ -176,6 +176,10 @@ class Encoder:
         # vendored plugin's existingAntiAffinityCounts).
         self.anti_terms: List[Tuple[int, int]] = []
         self._anti_ids: Dict[Tuple[int, int], int] = {}
+        # (namespace, sorted labels) -> bool[S] selector match vector; see
+        # match_vector. Append-only selector ids keep stale entries safe to
+        # detect by length.
+        self._match_cache: Dict[Tuple, np.ndarray] = {}
 
     def domain_id(self, key_idx: int, key: str, value: str) -> int:
         before = len(self.domains)
@@ -691,8 +695,7 @@ def encode_pods(
             b.aff_anti[i, j] = anti
             b.aff_required[i, j] = required
             b.aff_weight[i, j] = weight
-        for s, entry in enumerate(enc.selectors):
-            b.match_sel[i, s] = entry.matches(pod)
+        b.match_sel[i] = match_vector(enc, pod)
         for j, (pid, wild, ipid) in enumerate(enc.port_ids(pod)[:HP]):
             b.hp_pid[i, j] = pid
             b.hp_wild[i, j] = wild
@@ -859,6 +862,24 @@ def initial_anti_counts(
     return counts
 
 
+def match_vector(enc: Encoder, pod: Pod) -> np.ndarray:
+    """bool[S] — which registered selectors match this pod. Memoized by the
+    pod's (namespace, labels) signature: workload replicas are label-identical
+    clones, so a 100k-pod cluster hits the Python matcher only once per
+    distinct workload instead of pods x selectors times (the reference's
+    per-pod listers pay the full product; SURVEY §5.7 scale strategy)."""
+    S = max(len(enc.selectors), 1)
+    sig = (pod.meta.namespace, tuple(sorted(pod.meta.labels.items())))
+    cached = enc._match_cache.get(sig)
+    if cached is not None and cached.shape[0] == S:
+        return cached
+    vec = np.zeros(S, bool)
+    for s, entry in enumerate(enc.selectors):
+        vec[s] = entry.matches(pod)
+    enc._match_cache[sig] = vec
+    return vec
+
+
 def initial_selector_counts(
     enc: Encoder,
     table: NodeTable,
@@ -874,7 +895,5 @@ def initial_selector_counts(
         ni = node_index.get(node_name)
         if ni is None:
             continue
-        for s, entry in enumerate(enc.selectors):
-            if entry.matches(pod):
-                counts[s, ni] += 1.0
+        counts[:, ni] += match_vector(enc, pod)
     return counts

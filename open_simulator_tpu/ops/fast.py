@@ -74,24 +74,29 @@ from .state import pod_rows_from_batch
 J_CAP = 512
 
 
+# Channel layout of Trajectory.packed — everything the selection step needs,
+# in one array so the whole per-step state fits a small [N,CH] matrix.
+CH_CPU, CH_MEM, CH_RES_FAIL, CH_PORT_OK, CH_STO_OK, CH_GPU_OK = range(6)
+CH_STO_RAW, CH_GPU_RAW = 6, 7
+N_CH = 8
+
+
 class Trajectory(NamedTuple):
     """Per-node state/score evolution for one pod spec: index j = value after
-    j commits of this pod onto that node. Layout is [N, J, ...] — selection at
-    per-node commit counts x is a one-hot multiply+reduce over J (TPU lowers
-    general gathers poorly; an elementwise mask + reduction fuses cleanly)."""
+    j commits of this pod onto that node. Layout is [N, J, ...] — lane-local
+    per-node selection (TPU lowers general gathers poorly).
+
+    `packed` f32[N,J,CH] carries the selection-step channels (cpu/mem free,
+    the four local feasibility bits as 0.0/1.0, and the two raw scores); the
+    full-width arrays are only touched once per group (exit carry, takes)."""
     free: jnp.ndarray         # f32[N,J,R]
     gpu_free: jnp.ndarray     # f32[N,J,G]
     vg_free: jnp.ndarray      # f32[N,J,V]
     dev_free: jnp.ndarray     # f32[N,J,DV]
-    res_fail: jnp.ndarray     # bool[N,J]
-    port_ok: jnp.ndarray      # bool[N,J]
-    storage_ok: jnp.ndarray   # bool[N,J]
-    storage_raw: jnp.ndarray  # f32[N,J]
-    gpu_ok: jnp.ndarray       # bool[N,J]
-    gpu_raw: jnp.ndarray      # f32[N,J]
     gpu_take: jnp.ndarray     # f32[N,J,G]
     vg_take: jnp.ndarray      # f32[N,J,V]
     dev_take: jnp.ndarray     # f32[N,J,DV]
+    packed: jnp.ndarray       # f32[N,J,N_CH]
 
 
 @functools.partial(jax.jit, static_argnames=("j_steps",))
@@ -129,10 +134,18 @@ def build_trajectory(
         g_ok = gpu_mask(ns, vc, pod)
         g_raw = gpu_share_raw(ns, vc, pod)
         g_take = gpu_allocate_rowwise(ns, vc.gpu_free, pod)
+        packed = jnp.stack(
+            [
+                vc.free[:, 0], vc.free[:, 1],
+                res_fail.astype(jnp.float32), port_ok.astype(jnp.float32),
+                storage_ok.astype(jnp.float32), g_ok.astype(jnp.float32),
+                storage_raw, g_raw,
+            ],
+            axis=1,
+        )                                                   # [N,CH]
         out = (
             vc.free, vc.gpu_free, vc.vg_free, vc.dev_free,
-            res_fail, port_ok, storage_ok, storage_raw, g_ok, g_raw,
-            g_take, vg_take_all, dev_take_all,
+            g_take, vg_take_all, dev_take_all, packed,
         )
         vc2 = vc._replace(
             free=vc.free - pod.req[None, :],
@@ -173,52 +186,91 @@ def _sel_j(traj_arr: jnp.ndarray, oh: jnp.ndarray) -> jnp.ndarray:
     return jnp.sum(traj_arr * oh.astype(traj_arr.dtype)[:, :, None], axis=1)
 
 
-@functools.partial(jax.jit, static_argnames=("group_size",))
-def light_scan(
+class GroupFlags(NamedTuple):
+    """Host-known facts about a group's pod spec, passed as STATIC jit args
+    so _light_eval prunes provably-dead subgraphs at trace time. Every prune
+    replaces a subcomputation with the constant the full graph would produce
+    for this spec (ports_mask of a portless pod is all-true, the open-local
+    score of a volume-less pod is all-zero, ...), so placements stay
+    bit-identical — only tracing work and per-step kernels disappear."""
+    dyn_ports: bool      # pod requests host ports (port state evolves)
+    dyn_storage: bool    # pod has open-local volumes
+    dyn_gpu: bool        # pod requests GPU share (gpu_free evolves)
+    any_hard_spread: bool
+    any_soft_spread: bool
+    any_req_aff: bool    # required (anti)affinity terms
+    any_pref_aff: bool   # preferred (anti)affinity terms
+    any_anti_sym: bool   # existing anti-affinity terms repel this pod
+
+
+ALL_DYNAMIC = GroupFlags(*([True] * 8))
+
+
+def group_flags(row_np: dict, anti_topo_np: np.ndarray) -> GroupFlags:
+    """Derive GroupFlags from one pod's numpy feature row."""
+    spread_active = row_np["spread_topo"] >= 0
+    aff_active = row_np["aff_topo"] >= 0
+    return GroupFlags(
+        dyn_ports=bool((row_np["hp_pid"] > 0).any()),
+        dyn_storage=bool(row_np["has_local"]),
+        dyn_gpu=bool(row_np["gpu_mem"] > 0),
+        any_hard_spread=bool((spread_active & row_np["spread_hard"]).any()),
+        any_soft_spread=bool((spread_active & ~row_np["spread_hard"]).any()),
+        any_req_aff=bool((aff_active & row_np["aff_required"]).any()),
+        any_pref_aff=bool((aff_active & ~row_np["aff_required"]).any()),
+        any_anti_sym=bool(((anti_topo_np >= 0) & row_np["match_anti"]).any()),
+    )
+
+
+def _light_eval(
     ns: NodeStatic,
-    traj: Trajectory,
     carry0: Carry,
     pod: PodRow,
     static_ok: jnp.ndarray,
-    static_ff: jnp.ndarray,
     static_scores: dict,
     na_ok: jnp.ndarray,
     weights: jnp.ndarray,
-    x0: jnp.ndarray,
-    offset: jnp.ndarray,
-    group_size: int,
-    valid_count: jnp.ndarray,
-    filter_on=None,
+    fo: jnp.ndarray,
+    x: jnp.ndarray,
+    cur: jnp.ndarray,
+    flags: GroupFlags,
+    hoisted: dict,
 ):
-    """Select nodes for `group_size` pods of the group, starting from commit
-    state x0 (chunks of one group thread x through). Only steps with
-    offset + i < valid_count commit. Returns (x, nodes i32[G], jidx i32[G],
-    reasons i32[G,F])."""
+    """Evaluate feasibility + scores at commit state (x, cur) — shared by the
+    selection scan's step and the once-per-group reason attribution. Returns
+    (score f32[N] with -inf on infeasible, parts dict of effective per-filter
+    bools for first-fail attribution). `hoisted` carries group-static values
+    (computed once per chunk, loop-invariant): gpu_share score and the
+    port/storage/gpu masks when their state cannot evolve."""
     N = ns.valid.shape[0]
+    ones = jnp.ones(N, bool)
+    xf = x.astype(jnp.float32)
+    free2 = cur[:, CH_CPU:CH_MEM + 1]                 # [N,2]
+    res_fail_x = (cur[:, CH_RES_FAIL] > 0.5) & fo[F_RESOURCES]
+    if flags.dyn_ports:
+        port_ok = (cur[:, CH_PORT_OK] > 0.5) | ~fo[F_NODE_PORTS]
+    else:
+        port_ok = ones  # a portless pod conflicts nowhere (ports_mask)
+    if flags.dyn_storage:
+        storage_ok = cur[:, CH_STO_OK] > 0.5
+        storage_raw = cur[:, CH_STO_RAW]
+    else:
+        storage_ok = ones  # local_storage_eval: ok ≡ True when !has_local
+    if flags.dyn_gpu:
+        gpu_ok = cur[:, CH_GPU_OK] > 0.5
+        gpu_raw = cur[:, CH_GPU_RAW]
+    else:
+        gpu_ok = ones  # gpu_mask admits non-GPU pods everywhere
 
-    j_steps = traj.res_fail.shape[1]
-    fo = jnp.ones(NUM_FILTERS, bool) if filter_on is None else filter_on
+    def srow(sel_idx):
+        # sel_counts[sel_idx] after x commits: base + match * x — pure
+        # integer f32 arithmetic, bit-equal to the scan's iterative +1s.
+        return carry0.sel_counts[sel_idx] + pod.match_sel[sel_idx].astype(
+            jnp.float32
+        ) * xf
 
-    def step(x, i):
-        active = (offset + i) < valid_count
-        xf = x.astype(jnp.float32)
-        oh = _x_onehot(x, j_steps)
-        free = _sel_j(traj.free, oh)                      # [N,R]
-        res_fail_x = _sel_j(traj.res_fail, oh) & fo[F_RESOURCES]
-        port_ok = _sel_j(traj.port_ok, oh) | ~fo[F_NODE_PORTS]
-        storage_ok = _sel_j(traj.storage_ok, oh)
-        storage_raw = _sel_j(traj.storage_raw, oh)
-        gpu_ok = _sel_j(traj.gpu_ok, oh)
-        gpu_raw = _sel_j(traj.gpu_raw, oh)
-
-        def srow(sel_idx):
-            # sel_counts[sel_idx] after x commits: base + match * x — pure
-            # integer f32 arithmetic, bit-equal to the scan's iterative +1s.
-            return carry0.sel_counts[sel_idx] + pod.match_sel[sel_idx].astype(
-                jnp.float32
-            ) * xf
-
-        # PodTopologySpread hard constraints (mirror kernels.spread_mask)
+    # PodTopologySpread hard constraints (mirror kernels.spread_mask)
+    if flags.any_hard_spread:
         def one_spread(topo_idx, sel_idx, max_skew, hard):
             active_c = (topo_idx >= 0) & hard
             k = jnp.maximum(topo_idx, 0)
@@ -230,13 +282,17 @@ def light_scan(
 
         spread_ok = jnp.all(
             jax.vmap(one_spread, in_axes=(0, 0, 0, 0), out_axes=1)(
-                pod.spread_topo, pod.spread_sel, pod.spread_skew, pod.spread_hard
+                pod.spread_topo, pod.spread_sel, pod.spread_skew,
+                pod.spread_hard,
             ),
             axis=1,
         ) | ~fo[F_SPREAD]
+    else:
+        spread_ok = ones  # every constraint row is inactive => all-true
 
-        # InterPodAffinity required terms + anti-affinity symmetry
-        # (mirror kernels.pod_affinity_mask)
+    # InterPodAffinity required terms + anti-affinity symmetry
+    # (mirror kernels.pod_affinity_mask)
+    if flags.any_req_aff:
         def one_aff(topo_idx, sel_idx, anti, required):
             active_t = (topo_idx >= 0) & required
             k = jnp.maximum(topo_idx, 0)
@@ -248,10 +304,16 @@ def light_scan(
             ok_t = jnp.where(anti, cnt == 0, aff_feasible)
             return jnp.where(active_t, ok_t, jnp.ones(N, bool))
 
-        per_a = jax.vmap(one_aff, in_axes=(0, 0, 0, 0), out_axes=1)(
-            pod.aff_topo, pod.aff_sel, pod.aff_anti, pod.aff_required
+        req_ok = jnp.all(
+            jax.vmap(one_aff, in_axes=(0, 0, 0, 0), out_axes=1)(
+                pod.aff_topo, pod.aff_sel, pod.aff_anti, pod.aff_required
+            ),
+            axis=1,
         )
+    else:
+        req_ok = ones
 
+    if flags.any_anti_sym:
         def one_sym(topo_idx, base_row, own, match):
             active_t = (topo_idx >= 0) & match
             k = jnp.maximum(topo_idx, 0)
@@ -260,29 +322,33 @@ def light_scan(
             ok_t = (cnt == 0) | ~has_key
             return jnp.where(active_t, ok_t, jnp.ones(N, bool))
 
-        per_sym = jax.vmap(one_sym, in_axes=(0, 0, 0, 0), out_axes=1)(
-            ns.anti_topo, carry0.anti_counts, pod.own_anti, pod.match_anti
+        sym_ok = jnp.all(
+            jax.vmap(one_sym, in_axes=(0, 0, 0, 0), out_axes=1)(
+                ns.anti_topo, carry0.anti_counts, pod.own_anti, pod.match_anti
+            ),
+            axis=1,
         )
-        aff_ok = (jnp.all(per_a, axis=1) & jnp.all(per_sym, axis=1)) | ~fo[
-            F_POD_AFFINITY
-        ]
+    else:
+        sym_ok = ones
+    aff_ok = (req_ok & sym_ok) | ~fo[F_POD_AFFINITY]
 
-        mask = (
-            static_ok & port_ok & ~res_fail_x & spread_ok & aff_ok & storage_ok
-            & gpu_ok & ns.valid
-        )
+    mask = (
+        static_ok & port_ok & ~res_fail_x & spread_ok & aff_ok & storage_ok
+        & gpu_ok & ns.valid
+    )
 
-        # Dynamic scores (mirror kernels.score_* on the reconstructed state)
-        alloc2 = ns.alloc[:, :2]
-        free_after = free[:, :2] - pod.req[None, :2]
-        frac = jnp.where(alloc2 > 0, free_after / jnp.maximum(alloc2, 1e-9), 0.0)
-        la = jnp.clip(jnp.mean(frac, axis=1), 0.0, 1.0) * 100.0
+    # Dynamic scores (mirror kernels.score_* on the reconstructed state)
+    alloc2 = ns.alloc[:, :2]
+    free_after = free2 - pod.req[None, :2]
+    frac = jnp.where(alloc2 > 0, free_after / jnp.maximum(alloc2, 1e-9), 0.0)
+    la = jnp.clip(jnp.mean(frac, axis=1), 0.0, 1.0) * 100.0
 
-        used_after = ns.alloc[:, :2] - free[:, :2] + pod.req[None, :2]
-        frac_b = jnp.where(alloc2 > 0, used_after / jnp.maximum(alloc2, 1e-9), 0.0)
-        frac_b = jnp.clip(frac_b, 0.0, 1.0)
-        ba = (1.0 - jnp.abs(frac_b[:, 0] - frac_b[:, 1])) * 100.0
+    used_after = ns.alloc[:, :2] - free2 + pod.req[None, :2]
+    frac_b = jnp.where(alloc2 > 0, used_after / jnp.maximum(alloc2, 1e-9), 0.0)
+    frac_b = jnp.clip(frac_b, 0.0, 1.0)
+    ba = (1.0 - jnp.abs(frac_b[:, 0] - frac_b[:, 1])) * 100.0
 
+    if flags.any_soft_spread:
         def one_ssc(topo_idx, sel_idx, hard):
             active_c = (topo_idx >= 0) & ~hard
             k = jnp.maximum(topo_idx, 0)
@@ -297,9 +363,13 @@ def light_scan(
         )
         mx_sp = jnp.max(jnp.where(ns.valid, raw_sp, 0.0))
         sp_score = jnp.where(
-            mx_sp > 0, (mx_sp - raw_sp) * 100.0 / jnp.maximum(mx_sp, 1e-9), 100.0
+            mx_sp > 0, (mx_sp - raw_sp) * 100.0 / jnp.maximum(mx_sp, 1e-9),
+            100.0,
         )
+    else:
+        sp_score = jnp.full(N, 100.0)  # raw ≡ 0 => mx 0 => the 100.0 branch
 
+    if flags.any_pref_aff:
         def one_asc(topo_idx, sel_idx, anti, required, weight):
             active_t = (topo_idx >= 0) & ~required
             k = jnp.maximum(topo_idx, 0)
@@ -316,69 +386,179 @@ def light_scan(
         )
         any_active = jnp.any((pod.aff_topo >= 0) & ~pod.aff_required)
         ipa = jnp.where(any_active, _minmax_normalize(raw_a, ns.valid), 0.0)
+    else:
+        ipa = jnp.zeros(N)  # the where(any_active, ..., 0.0) branch
 
-        by_name = {
-            "balanced_allocation": ba,
-            "least_allocated": la,
-            "topology_spread": sp_score,
-            "inter_pod_affinity": ipa,
-            "gpu_share": _minmax_normalize(gpu_raw, ns.valid),
-            "open_local": jnp.where(
+    by_name = {
+        "balanced_allocation": ba,
+        "least_allocated": la,
+        "topology_spread": sp_score,
+        "inter_pod_affinity": ipa,
+        "gpu_share": (
+            _minmax_normalize(gpu_raw, ns.valid)
+            if flags.dyn_gpu
+            else hoisted["gpu_score"]
+        ),
+        "open_local": (
+            jnp.where(
                 pod.has_local, _minmax_normalize(storage_raw, ns.valid), 0.0
-            ),
-            **static_scores,
-        }
-        stacked = jnp.stack([by_name[k] for k in WEIGHT_ORDER], axis=0)
-        score = jnp.sum(stacked * weights[:, None], axis=0)
-        score = jnp.where(mask, score, -jnp.inf)
+            )
+            if flags.dyn_storage
+            else jnp.zeros(N)  # has_local False => the 0.0 branch
+        ),
+        **static_scores,
+    }
+    stacked = jnp.stack([by_name[k] for k in WEIGHT_ORDER], axis=0)
+    score = jnp.sum(stacked * weights[:, None], axis=0)
+    score = jnp.where(mask, score, -jnp.inf)
+    parts = {
+        "port_ok": port_ok, "res_fail": res_fail_x, "spread_ok": spread_ok,
+        "aff_ok": aff_ok, "storage_ok": storage_ok, "gpu_ok": gpu_ok,
+    }
+    return score, parts
+
+
+def _hoisted_values(ns: NodeStatic, cur: jnp.ndarray, flags: GroupFlags) -> dict:
+    """Group-invariant values _light_eval needs, computed once per jit call
+    (outside the scan body). For a non-GPU group gpu_free never changes, so
+    the gpu-share score is frozen at its entry value — cur's CH_GPU_RAW
+    channel is constant across lanes for such groups."""
+    out = {}
+    if not flags.dyn_gpu:
+        out["gpu_score"] = _minmax_normalize(cur[:, CH_GPU_RAW], ns.valid)
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("group_size", "flags"))
+def light_scan(
+    ns: NodeStatic,
+    traj: Trajectory,
+    carry0: Carry,
+    pod: PodRow,
+    static_ok: jnp.ndarray,
+    static_scores: dict,
+    na_ok: jnp.ndarray,
+    weights: jnp.ndarray,
+    x0: jnp.ndarray,
+    cur0: jnp.ndarray,
+    offset: jnp.ndarray,
+    group_size: int,
+    valid_count: jnp.ndarray,
+    filter_on=None,
+    flags: GroupFlags = ALL_DYNAMIC,
+):
+    """Select nodes for `group_size` pods of the group, starting from commit
+    state (x0, cur0) — chunks of one group thread both through. Only steps
+    with offset + i < valid_count commit. Returns (x, cur, nodes i32[G],
+    jidx i32[G]).
+
+    The scan carry keeps `cur` = packed[n, x_n] for every node (invariant:
+    a commit only advances the chosen node's lane, so one dynamic row update
+    per step maintains it) — the step never re-reads the [N,J,*] trajectory.
+    Failure reasons are NOT computed per step: an infeasible step commits
+    nothing, so the state freezes and every later step of the group fails
+    identically — light_reasons attributes the whole failure suffix once."""
+    N = ns.valid.shape[0]
+    j_steps = traj.packed.shape[1]
+    fo = jnp.ones(NUM_FILTERS, bool) if filter_on is None else filter_on
+    hoisted = _hoisted_values(ns, cur0, flags)
+
+    def step(carry_xc, i):
+        x, cur = carry_xc
+        active = (offset + i) < valid_count
+        score, _ = _light_eval(
+            ns, carry0, pod, static_ok, static_scores, na_ok, weights, fo,
+            x, cur, flags, hoisted,
+        )
         node = jnp.argmax(score)
-        ok = jnp.any(mask) & active
+        # any(mask) == the winning score is finite (infeasible rows are -inf)
+        ok = (score[node] > -jnp.inf) & active
         node_out = jnp.where(ok, node, -1)
         jidx = jnp.where(ok, x[node], 0)
 
         onehot = (jnp.arange(N) == node) & ok
         x2 = x + onehot.astype(jnp.int32)
+        # Maintain cur = packed[n, x_n]: refresh only the chosen node's row.
+        j_next = jnp.clip(x[node] + 1, 0, j_steps - 1)
+        new_row = jax.lax.dynamic_slice(
+            traj.packed, (node, j_next, 0), (1, 1, N_CH)
+        )[0]
+        row = jnp.where(ok, new_row, cur[node][None, :])
+        cur2 = jax.lax.dynamic_update_slice(cur, row, (node, 0))
 
-        first_fail = jnp.where(
-            static_ff < NUM_FILTERS,
-            static_ff,
+        return (x2, cur2), (node_out.astype(jnp.int32), jidx.astype(jnp.int32))
+
+    (x_final, cur_final), (nodes, jidxs) = jax.lax.scan(
+        step, (x0, cur0), jnp.arange(group_size)
+    )
+    return x_final, cur_final, nodes, jidxs
+
+
+@functools.partial(jax.jit, static_argnames=("flags",))
+def light_reasons(
+    ns: NodeStatic,
+    carry0: Carry,
+    pod: PodRow,
+    static_ok: jnp.ndarray,
+    static_ff: jnp.ndarray,
+    static_scores: dict,
+    na_ok: jnp.ndarray,
+    weights: jnp.ndarray,
+    x: jnp.ndarray,
+    cur: jnp.ndarray,
+    filter_on=None,
+    flags: GroupFlags = ALL_DYNAMIC,
+) -> jnp.ndarray:
+    """Failure-reason histogram i32[F] at state (x, cur) — evaluated once per
+    group for its failure suffix (identical for every failed pod, because a
+    failed step commits nothing). Matches the grouped path's per-step nested
+    first-fail attribution exactly."""
+    fo = jnp.ones(NUM_FILTERS, bool) if filter_on is None else filter_on
+    _, p = _light_eval(
+        ns, carry0, pod, static_ok, static_scores, na_ok, weights, fo, x, cur,
+        flags, _hoisted_values(ns, cur, flags),
+    )
+    first_fail = jnp.where(
+        static_ff < NUM_FILTERS,
+        static_ff,
+        jnp.where(
+            ~p["port_ok"],
+            F_NODE_PORTS,
             jnp.where(
-                ~port_ok,
-                F_NODE_PORTS,
+                p["res_fail"],
+                F_RESOURCES,
                 jnp.where(
-                    res_fail_x,
-                    F_RESOURCES,
+                    ~p["spread_ok"],
+                    F_SPREAD,
                     jnp.where(
-                        ~spread_ok,
-                        F_SPREAD,
+                        ~p["aff_ok"],
+                        F_POD_AFFINITY,
                         jnp.where(
-                            ~aff_ok,
-                            F_POD_AFFINITY,
-                            jnp.where(
-                                ~storage_ok,
-                                F_STORAGE,
-                                jnp.where(~gpu_ok, F_GPU, NUM_FILTERS),
-                            ),
+                            ~p["storage_ok"],
+                            F_STORAGE,
+                            jnp.where(~p["gpu_ok"], F_GPU, NUM_FILTERS),
                         ),
                     ),
                 ),
             ),
-        )
-        reason_counts = jnp.zeros(NUM_FILTERS, jnp.int32).at[
-            jnp.clip(first_fail, 0, NUM_FILTERS - 1)
-        ].add(jnp.where((first_fail < NUM_FILTERS) & ns.valid, 1, 0))
-        reason_counts = jnp.where(ok, jnp.zeros_like(reason_counts), reason_counts)
+        ),
+    )
+    return jnp.zeros(NUM_FILTERS, jnp.int32).at[
+        jnp.clip(first_fail, 0, NUM_FILTERS - 1)
+    ].add(jnp.where((first_fail < NUM_FILTERS) & ns.valid, 1, 0))
 
-        return x2, (node_out.astype(jnp.int32), jidx.astype(jnp.int32), reason_counts)
 
-    x_final, (nodes, jidxs, reasons) = jax.lax.scan(step, x0, jnp.arange(group_size))
-
+@jax.jit
+def gather_takes(traj: Trajectory, nodes: jnp.ndarray, jidxs: jnp.ndarray):
+    """Per-pod allocation takes from (chosen node, commit index) — one gather
+    per group after all chunks finish."""
+    N = traj.packed.shape[0]
     node_c = jnp.clip(nodes, 0, N - 1)
     placed = (nodes >= 0)[:, None]
     gpu_take = jnp.where(placed, traj.gpu_take[node_c, jidxs], 0.0)
     vg_take = jnp.where(placed, traj.vg_take[node_c, jidxs], 0.0)
     dev_take = jnp.where(placed, traj.dev_take[node_c, jidxs], 0.0)
-    return x_final, nodes, reasons, gpu_take, vg_take, dev_take
+    return gpu_take, vg_take, dev_take
 
 
 @jax.jit
@@ -390,7 +570,7 @@ def exit_carry(
     trajectory (capturing the scan's exact f32 subtraction sequence); the
     integer count tables are reconstructed as base + per-commit-add * x."""
     xf = x.astype(jnp.float32)
-    oh = _x_onehot(x, traj.res_fail.shape[1])
+    oh = _x_onehot(x, traj.packed.shape[1])
     add_any, add_wild, add_ipc = port_adds(
         carry0.port_any.shape[0], carry0.port_ipc.shape[0], pod
     )
@@ -430,6 +610,15 @@ def _bucket_j(j: int) -> int:
     return 1 << max(int(j) - 1, 7).bit_length()
 
 
+def _bucket_light(n: int) -> int:
+    """Chunk bucket for the light scan: light steps are cheap but not free,
+    so pow2 buckets (up to 2x padding waste) hurt more than the few extra
+    compiles of 2048-granular buckets."""
+    if n <= 2048:
+        return _bucket(n)
+    return (n + 2047) // 2048 * 2048
+
+
 def schedule_batch_fast(
     ns: NodeStatic,
     carry: Carry,
@@ -438,12 +627,17 @@ def schedule_batch_fast(
     max_group_chunk: int = 16384,
     force_fast: bool = False,
     filter_on=None,
+    extra_filters=(),
+    extra_scores=(),
 ) -> Tuple[Carry, np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """schedule_batch semantics (bit-identical placements/reasons/takes/carry)
     with per-group trajectory acceleration; same returns as
     schedule_batch_grouped. Groups too small to amortize a trajectory (or with
     absurdly deep ones, J > J_CAP) take the grouped per-pod scan instead.
-    `force_fast` disables the amortization heuristic (tests)."""
+    `force_fast` disables the amortization heuristic (tests). Out-of-tree
+    plugins (extra_filters/extra_scores) may read the carry arbitrarily,
+    which voids the trajectory's node-local-evolution premise — any plugin
+    routes the whole batch through the grouped path."""
     P = batch.p
     G = ns.gpu_total.shape[1]
     V = ns.vg_cap.shape[1]
@@ -460,16 +654,35 @@ def schedule_batch_fast(
     # A disabled NodeResourcesFit filter voids the trajectory-length bound
     # (the resource filter is what stops a node's commit count at c_max, see
     # _traj_len) — those profiles take the per-pod grouped path.
-    res_filter_on = filter_on is None or bool(
-        np.asarray(filter_on)[F_RESOURCES]
-    )
+    res_filter_on = (
+        filter_on is None or bool(np.asarray(filter_on)[F_RESOURCES])
+    ) and not extra_filters and not extra_scores
+    # One device->host sync for ALL groups' trajectory lengths: free only
+    # shrinks while a batch schedules (no evictions mid-batch), so per-node
+    # commit caps computed from the batch-entry free are safe upper bounds
+    # for every later group.
+    free_entry = np.asarray(carry.free) if res_filter_on else None
+    anti_topo_np = np.asarray(ns.anti_topo)
 
     for start, length in group_runs(batch):
         row = jax.tree.map(lambda a: a[start], rows_all)
+        flags = group_flags(
+            {
+                "hp_pid": batch.hp_pid[start],
+                "has_local": batch.has_local[start],
+                "gpu_mem": batch.gpu_mem[start],
+                "spread_topo": batch.spread_topo[start],
+                "spread_hard": batch.spread_hard[start],
+                "aff_topo": batch.aff_topo[start],
+                "aff_required": batch.aff_required[start],
+                "match_anti": batch.match_anti[start],
+            },
+            anti_topo_np,
+        )
         j_need = (
-            _traj_len(np.asarray(carry.free), valid_np, batch.req[start], length)
-            if res_filter_on and (force_fast or length >= 64)
-            else None  # skip the device->host sync for never-fast groups
+            _traj_len(free_entry, valid_np, batch.req[start], length)
+            if free_entry is not None and (force_fast or length >= 64)
+            else None
         )
         use_fast = (
             j_need is not None
@@ -482,7 +695,8 @@ def schedule_batch_fast(
                 n = min(length - done, max_group_chunk)
                 g = _bucket(n)
                 carry, (nodes, reasons, take, vg_take, dev_take) = _group_call(
-                    ns, carry, row, g, jnp.int32(n), weights, filter_on
+                    ns, carry, row, g, jnp.int32(n), weights, filter_on,
+                    extra_filters, extra_scores,
                 )
                 sl = slice(start + done, start + done + n)
                 nodes_out[sl] = np.asarray(nodes)[:n]
@@ -498,22 +712,40 @@ def schedule_batch_fast(
             ns, carry, row, weights, j_steps, filter_on
         )
         x = jnp.zeros(N, jnp.int32)
+        cur = traj.packed[:, 0, :]
+        chunks = []
         done = 0
         while done < length:
             n = min(length - done, max_group_chunk)
-            g = _bucket(n)
-            x, nodes, reasons, take, vg_take, dev_take = light_scan(
-                ns, traj, carry, row, static_ok, static_ff, static_scores,
-                na_ok, weights, x, jnp.int32(done), g,
-                jnp.int32(length), filter_on,
+            g = _bucket_light(n)
+            x, cur, nodes, jidxs = light_scan(
+                ns, traj, carry, row, static_ok, static_scores,
+                na_ok, weights, x, cur, jnp.int32(done), g,
+                jnp.int32(length), filter_on, flags,
             )
-            sl = slice(start + done, start + done + n)
-            nodes_out[sl] = np.asarray(nodes)[:n]
-            reasons_out[sl] = np.asarray(reasons)[:n]
-            take_out[sl] = np.asarray(take)[:n].astype(np.int32)
-            vg_out[sl] = np.asarray(vg_take)[:n]
-            dev_out[sl] = np.asarray(dev_take)[:n]
+            chunks.append((n, nodes, jidxs))
             done += n
+        # One transfer per group (per-chunk np.asarray syncs dominated the
+        # host-side cost at TPU-tunnel latencies).
+        nodes_d = jnp.concatenate([c[1][: c[0]] for c in chunks])
+        jidx_d = jnp.concatenate([c[2][: c[0]] for c in chunks])
+        take_d, vg_d, dev_d = gather_takes(traj, nodes_d, jidx_d)
+        sl = slice(start, start + length)
+        nodes_np = np.asarray(nodes_d)
+        nodes_out[sl] = nodes_np
+        take_out[sl] = np.asarray(take_d).astype(np.int32)
+        vg_out[sl] = np.asarray(vg_d)
+        dev_out[sl] = np.asarray(dev_d)
+        if (nodes_np < 0).any():
+            # A failed step commits nothing, so the whole failure suffix of
+            # the group shares one state — attribute reasons once.
+            reason_row = np.asarray(
+                light_reasons(
+                    ns, carry, row, static_ok, static_ff, static_scores,
+                    na_ok, weights, x, cur, filter_on, flags,
+                )
+            )
+            reasons_out[sl][nodes_np < 0] = reason_row
         carry = exit_carry(ns, carry, row, traj, x)
 
     return carry, nodes_out, reasons_out, take_out, vg_out, dev_out

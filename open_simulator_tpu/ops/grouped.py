@@ -31,6 +31,7 @@ import numpy as np
 from .encode import PodBatch
 from .kernels import (
     Carry,
+    F_EXTRA,
     F_GPU,
     F_NODE_AFFINITY,
     F_NODE_NAME,
@@ -117,6 +118,8 @@ def schedule_group(
     valid_count: jnp.ndarray,
     weights: jnp.ndarray,
     filter_on=None,
+    extra_filters=(),
+    extra_scores=(),
 ):
     """Schedule `group_size` copies of one pod spec; only the first
     `valid_count` steps commit. Returns (carry, nodes i32[G], reasons i32[G,F]).
@@ -138,9 +141,12 @@ def schedule_group(
         # collapses the two local_storage_eval calls within one jit
         storage_ok, _, _, storage_raw = local_storage_eval(ns, c, pod)
         gpu_ok = gpu_mask(ns, c, pod)
+        extra_ok = jnp.ones(ns.valid.shape[0], bool)
+        for f in extra_filters:
+            extra_ok = extra_ok & f(ns, c, pod)
         mask = (
             static_ok & port_ok & ~res_fail & spread_ok & aff_ok & storage_ok
-            & gpu_ok & ns.valid
+            & gpu_ok & extra_ok & ns.valid
         )
 
         # Stack in WEIGHT_ORDER exactly like run_scores so the f32 summation
@@ -158,6 +164,8 @@ def schedule_group(
         }
         stacked = jnp.stack([by_name[k] for k in WEIGHT_ORDER], axis=0)
         score = jnp.sum(stacked * weights[:, None], axis=0)
+        for fn, w in extra_scores:
+            score = score + w * fn(ns, c, pod)
         score = jnp.where(mask, score, -jnp.inf)
         node = jnp.argmax(score)
         ok = jnp.any(mask) & active
@@ -196,7 +204,13 @@ def schedule_group(
                             jnp.where(
                                 ~storage_ok,
                                 F_STORAGE,
-                                jnp.where(~gpu_ok, F_GPU, NUM_FILTERS),
+                                jnp.where(
+                                    ~gpu_ok,
+                                    F_GPU,
+                                    jnp.where(
+                                        ~extra_ok, F_EXTRA, NUM_FILTERS
+                                    ),
+                                ),
                             ),
                         ),
                     ),
@@ -224,15 +238,24 @@ def schedule_group(
     return jax.lax.scan(step, carry, jnp.arange(group_size))
 
 
-_group_jit = jax.jit(schedule_group, static_argnames=("group_size",))
+_group_jit = jax.jit(
+    schedule_group,
+    static_argnames=("group_size", "extra_filters", "extra_scores"),
+)
 
 
-def _group_call(ns, carry, pod, group_size, valid_count, weights, filter_on=None):
-    """_group_jit with filter_on omitted when default (keeps the all-on jit
-    cache entry shared with callers that never pass a profile)."""
-    if filter_on is None:
+def _group_call(
+    ns, carry, pod, group_size, valid_count, weights, filter_on=None,
+    extra_filters=(), extra_scores=(),
+):
+    """_group_jit with defaults omitted (keeps the plain jit cache entry
+    shared with callers that never pass a profile or plugins)."""
+    if filter_on is None and not extra_filters and not extra_scores:
         return _group_jit(ns, carry, pod, group_size, valid_count, weights)
-    return _group_jit(ns, carry, pod, group_size, valid_count, weights, filter_on)
+    return _group_jit(
+        ns, carry, pod, group_size, valid_count, weights, filter_on,
+        extra_filters, extra_scores,
+    )
 
 
 def _row_signature(batch: PodBatch) -> np.ndarray:
@@ -291,6 +314,8 @@ def schedule_batch_grouped(
     weights,
     max_group_chunk: int = 16384,
     filter_on=None,
+    extra_filters=(),
+    extra_scores=(),
 ) -> Tuple[Carry, np.ndarray, np.ndarray, np.ndarray]:
     """schedule_batch semantics via per-group inner scans.
 
@@ -317,7 +342,8 @@ def schedule_batch_grouped(
             n = min(length - done, max_group_chunk)
             g = _bucket(n)
             carry, (nodes, reasons, take, vg_take, dev_take) = _group_call(
-                ns, carry, row, g, jnp.int32(n), weights, filter_on
+                ns, carry, row, g, jnp.int32(n), weights, filter_on,
+                extra_filters, extra_scores,
             )
             sl = slice(start + done, start + done + n)
             nodes_out[sl] = np.asarray(nodes)[:n]

@@ -50,7 +50,8 @@ F_SPREAD = 6
 F_POD_AFFINITY = 7
 F_STORAGE = 8
 F_GPU = 9
-NUM_FILTERS = 10
+F_EXTRA = 10  # out-of-tree device plugins (plugins/ registry)
+NUM_FILTERS = 11
 
 # Kube filter-plugin name -> filter index, for KubeSchedulerConfiguration
 # enable/disable fidelity (utils.go:304-381 builds the full Filter plugin
@@ -79,6 +80,7 @@ FILTER_MESSAGES = (
     "node(s) didn't match pod affinity/anti-affinity rules",
     "node(s) didn't have enough local storage",
     "node(s) didn't have enough free GPU memory",
+    "node(s) were rejected by an out-of-tree filter plugin",
 )
 
 # Score weights, matching the default v1beta1 provider weights
@@ -717,13 +719,18 @@ def resource_fail(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
     return static_fail | whole_fail
 
 
-def run_filters(ns: NodeStatic, carry: Carry, pod: PodRow, filter_on=None):
+def run_filters(
+    ns: NodeStatic, carry: Carry, pod: PodRow, filter_on=None, extra_filters=()
+):
     """All filter plugins -> (mask bool[N], first_fail i32[N]).
 
     first_fail is the index of the first failing filter per node (kube stops a
     node's filter chain at the first failure), or NUM_FILTERS when feasible.
     `filter_on` (bool[NUM_FILTERS] or None = all on) disables filter plugins
     per the scheduler profile: a disabled filter never fails a node.
+    `extra_filters` is the out-of-tree registry (plugins/): jax-traceable
+    `f(ns, carry, pod) -> bool[N]` predicates AND-ed into the F_EXTRA slot
+    (the extraRegistry analog, simulator.go:190-203).
     """
     # NodeUnschedulable filter admits pods tolerating the synthetic
     # node.kubernetes.io/unschedulable:NoSchedule taint (plugin parity);
@@ -735,6 +742,9 @@ def run_filters(ns: NodeStatic, carry: Carry, pod: PodRow, filter_on=None):
         & ((pod.tol_effect == 0) | (pod.tol_effect == 1)),
     )
     na_ok = node_affinity_mask(ns, pod)
+    extra_fail = jnp.zeros(ns.valid.shape[0], bool)
+    for f in extra_filters:
+        extra_fail = extra_fail | ~f(ns, carry, pod)
     fails = jnp.stack(
         [
             ns.unsched & ~unsched_tolerated,
@@ -747,6 +757,7 @@ def run_filters(ns: NodeStatic, carry: Carry, pod: PodRow, filter_on=None):
             ~pod_affinity_mask(ns, carry, pod),
             ~local_storage_mask(ns, carry, pod),
             ~gpu_mask(ns, carry, pod),
+            extra_fail,
         ],
         axis=1,
     )                                                           # [N,F]
@@ -914,8 +925,16 @@ def score_gpu_share(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
     return _minmax_normalize(gpu_share_raw(ns, carry, pod), ns.valid)
 
 
-def run_scores(ns: NodeStatic, carry: Carry, pod: PodRow, weights: jnp.ndarray) -> jnp.ndarray:
-    """Weighted sum of all normalized score plugins -> f32[N]."""
+def run_scores(
+    ns: NodeStatic,
+    carry: Carry,
+    pod: PodRow,
+    weights: jnp.ndarray,
+    extra_scores=(),
+) -> jnp.ndarray:
+    """Weighted sum of all normalized score plugins -> f32[N]. `extra_scores`
+    is the out-of-tree registry: (fn, weight) pairs of jax-traceable
+    `fn(ns, carry, pod) -> f32[N]` kernels added after the in-tree sum."""
     na_ok = node_affinity_mask(ns, pod)  # CSE-merged with run_filters' copy
     by_name = {
         "balanced_allocation": score_balanced(ns, carry, pod),
@@ -930,7 +949,10 @@ def run_scores(ns: NodeStatic, carry: Carry, pod: PodRow, weights: jnp.ndarray) 
         "open_local": score_open_local(ns, carry, pod),
     }
     stacked = jnp.stack([by_name[k] for k in WEIGHT_ORDER], axis=0)  # [W,N]
-    return jnp.sum(stacked * weights[:, None], axis=0)
+    score = jnp.sum(stacked * weights[:, None], axis=0)
+    for fn, w in extra_scores:
+        score = score + w * fn(ns, carry, pod)
+    return score
 
 
 # ---------------------------------------------------------------------------
@@ -938,10 +960,16 @@ def run_scores(ns: NodeStatic, carry: Carry, pod: PodRow, weights: jnp.ndarray) 
 # ---------------------------------------------------------------------------
 
 def schedule_step(
-    ns: NodeStatic, weights: jnp.ndarray, carry: Carry, pod: PodRow, filter_on=None
+    ns: NodeStatic,
+    weights: jnp.ndarray,
+    carry: Carry,
+    pod: PodRow,
+    filter_on=None,
+    extra_filters=(),
+    extra_scores=(),
 ):
-    mask, first_fail = run_filters(ns, carry, pod, filter_on)
-    score = run_scores(ns, carry, pod, weights)
+    mask, first_fail = run_filters(ns, carry, pod, filter_on, extra_filters)
+    score = run_scores(ns, carry, pod, weights, extra_scores)
     score = jnp.where(mask, score, -jnp.inf)
     node = jnp.argmax(score)  # first max => lowest node index tie-break
     ok = jnp.any(mask) & pod.valid
@@ -981,9 +1009,15 @@ def schedule_step(
     )
 
 
-@functools.partial(jax.jit, static_argnames=())
+@functools.partial(jax.jit, static_argnames=("extra_filters", "extra_scores"))
 def schedule_batch(
-    ns: NodeStatic, carry: Carry, pods: PodRow, weights: jnp.ndarray, filter_on=None
+    ns: NodeStatic,
+    carry: Carry,
+    pods: PodRow,
+    weights: jnp.ndarray,
+    filter_on=None,
+    extra_filters=(),
+    extra_scores=(),
 ):
     """Schedule a whole PodBatch sequentially on device.
 
@@ -994,7 +1028,9 @@ def schedule_batch(
     """
 
     def step(c, pod):
-        return schedule_step(ns, weights, c, pod, filter_on)
+        return schedule_step(
+            ns, weights, c, pod, filter_on, extra_filters, extra_scores
+        )
 
     final_carry, (nodes, reasons, gpu_take, vg_take, dev_take) = jax.lax.scan(
         step, carry, pods

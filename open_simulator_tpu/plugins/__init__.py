@@ -1,0 +1,61 @@
+"""Out-of-tree scheduler plugins: the extensible-algorithm hook.
+
+Parity: the reference accepts an `extraRegistry` of user plugin factories and
+hands it to scheduler.New (WithFrameworkOutOfTreeRegistry,
+`/root/reference/pkg/simulator/simulator.go:190-203`; the README's
+"extensible scheduling algorithm" feature). The TPU-native equivalent is a
+registry of jax-traceable device kernels over the cluster-state tensors:
+
+  - a Filter plugin is `fn(ns: NodeStatic, carry: Carry, pod: PodRow) ->
+    bool[N]` (True = node feasible); failures report as "rejected by an
+    out-of-tree filter plugin" (kernels.F_EXTRA).
+  - a Score plugin is `fn(ns, carry, pod) -> f32[N]`, added to the weighted
+    in-tree sum at its configured weight (normalize inside your kernel if you
+    want 0..100 semantics).
+
+Plugins see exactly the state the in-tree kernels see: NodeStatic (immutable
+node features), Carry (free resources, selector/anti-affinity counts, GPU and
+storage state, host-port tables) and the encoded PodRow. They run inside the
+scheduling jit, so they must be pure and shape-static — standard jax rules.
+
+Example:
+
+    from open_simulator_tpu.plugins import DevicePlugin
+    from open_simulator_tpu.engine.simulator import simulate
+
+    def spare_cpu_filter(ns, carry, pod):
+        return carry.free[:, 0] >= 2 * pod.req[0]   # keep 2x headroom
+
+    plug = DevicePlugin(name="headroom", filter_fn=spare_cpu_filter)
+    simulate(cluster, apps, plugins=[plug])
+
+Because an out-of-tree plugin may read the carry arbitrarily, batches that
+carry plugins schedule through the per-pod grouped path (the trajectory fast
+path assumes node-local state evolution; ops/fast.py docstring).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional, Sequence, Tuple
+
+
+class DevicePlugin(NamedTuple):
+    """One out-of-tree plugin: a Filter kernel, a Score kernel, or both."""
+    name: str
+    filter_fn: Optional[Callable] = None   # (ns, carry, pod) -> bool[N]
+    score_fn: Optional[Callable] = None    # (ns, carry, pod) -> f32[N]
+    weight: float = 1.0
+
+
+def split_registry(
+    plugins: Sequence[DevicePlugin],
+) -> Tuple[tuple, tuple]:
+    """(extra_filters, extra_scores) tuples for the kernel entry points.
+    Tuples (hashable, order-stable) because they ride as static jit args."""
+    filters = tuple(p.filter_fn for p in plugins if p.filter_fn is not None)
+    scores = tuple(
+        (p.score_fn, float(p.weight))
+        for p in plugins
+        if p.score_fn is not None
+    )
+    return filters, scores

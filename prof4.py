@@ -1,0 +1,100 @@
+"""Phase timing at 10k nodes + step-floor at N=10016 (scratch)."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench import build_state
+from open_simulator_tpu.ops import fast
+from open_simulator_tpu.ops.grouped import group_runs
+from open_simulator_tpu.ops.kernels import weights_array
+from open_simulator_tpu.ops.state import pod_rows_from_batch
+
+# --- floor test at N=10016 ---
+N, G = 10016, 2048
+x0 = jnp.zeros(N, jnp.float32)
+sc = jnp.arange(N, dtype=jnp.float32) % 97.0
+
+
+def timeit(fn, *args):
+    jax.block_until_ready(fn(*args))
+    t0 = time.time()
+    jax.block_until_ready(fn(*args))
+    return time.time() - t0
+
+
+@jax.jit
+def floor_body(x0):
+    def step(x, i):
+        s = x + sc
+        lo = jnp.min(jnp.where(s > 0, s, jnp.inf))
+        hi = jnp.max(s)
+        n = jnp.argmax((s - lo) / jnp.maximum(hi - lo, 1e-9))
+        return x - (jnp.arange(N) == n) * 0.5, n
+    return jax.lax.scan(step, x0, jnp.arange(G))
+
+
+t = timeit(floor_body, x0)
+print(f"floor (minmax+argmax) N=10016: {1e6*t/G:.1f} us/step")
+
+# --- phase timing of the current fast path at 10k nodes, 20k pods ---
+ns, carry0, batch = build_state(10000, 20000)
+w = weights_array()
+rows_all = pod_rows_from_batch(batch)
+NN = ns.valid.shape[0]
+valid_np = np.asarray(ns.valid)
+
+for rep in range(2):
+    carry = carry0
+    t_all0 = time.time()
+    t0 = time.time()
+    runs = group_runs(batch)
+    t_hash = time.time() - t0
+    t0 = time.time()
+    free_entry = np.asarray(carry.free)
+    t_tl = time.time() - t0
+    t_traj = t_light = t_np = t_exit = 0.0
+    for start, length in runs:
+        row = jax.tree.map(lambda a: a[start], rows_all)
+        j_need = fast._traj_len(free_entry, valid_np, batch.req[start], length)
+        j_steps = fast._bucket_j(j_need)
+        t0 = time.time()
+        out = fast.build_trajectory(ns, carry, row, w, j_steps)
+        jax.block_until_ready(out[0].packed)
+        t_traj += time.time() - t0
+        traj, s_ok, s_ff, s_sc, na_ok = out
+        x = jnp.zeros(NN, jnp.int32)
+        cur = traj.packed[:, 0, :]
+        chunks = []
+        done = 0
+        n_steps = 0
+        t0 = time.time()
+        while done < length:
+            n = min(length - done, 16384)
+            g = fast._bucket_light(n)
+            n_steps += g
+            x, cur, nodes, jidxs = fast.light_scan(
+                ns, traj, carry, row, s_ok, s_sc, na_ok, w,
+                x, cur, jnp.int32(done), g, jnp.int32(length),
+            )
+            chunks.append((n, nodes, jidxs))
+            done += n
+        jax.block_until_ready(x)
+        t_light += time.time() - t0
+        t0 = time.time()
+        nodes_d = jnp.concatenate([c[1][: c[0]] for c in chunks])
+        jidx_d = jnp.concatenate([c[2][: c[0]] for c in chunks])
+        take_d, vg_d, dev_d = fast.gather_takes(traj, nodes_d, jidx_d)
+        _ = np.asarray(nodes_d), np.asarray(take_d)
+        t_np += time.time() - t0
+        t0 = time.time()
+        carry = fast.exit_carry(ns, carry, row, traj, x)
+        jax.block_until_ready(carry.free)
+        t_exit += time.time() - t0
+    wall = time.time() - t_all0
+    print(
+        f"rep{rep}: wall {wall:.2f}s hash {t_hash:.2f} free_xfer {t_tl:.2f} "
+        f"traj {t_traj:.2f} light {t_light:.2f} ({1e6*t_light/20000:.0f}us/pod) "
+        f"np {t_np:.2f} exit {t_exit:.2f}"
+    )

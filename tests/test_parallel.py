@@ -152,3 +152,32 @@ def test_tile_pod_batch_matches_full_encoding():
         np.asarray(out1[1])[:10], np.asarray(out2[1])[:10]
     )
     assert b2.keys[:3] == ["d/tpl-0", "d/tpl-1", "d/tpl-2"]
+
+
+def test_fast_path_under_mesh_matches_single_device():
+    """The trajectory fast path (ops/fast.py) under GSPMD node-axis sharding:
+    big groups route through build_trajectory + light_scan with sharded
+    ns/carry — placements, reasons and the exit carry must equal the
+    unsharded run exactly (the `simon apply --devices N` path at scale)."""
+    from bench import build_state
+    from open_simulator_tpu.ops.fast import schedule_batch_fast
+
+    ns, carry, batch = build_state(64, 512)
+    w = weights_array()
+    carry_ref, nodes_ref, reasons_ref, *_ = schedule_batch_fast(
+        ns, carry, batch, w, force_fast=True
+    )
+
+    mesh = make_mesh()
+    ns_sh, carry_sh = shard_state(mesh, ns, carry)
+    carry_out, nodes_sh, reasons_sh, *_ = schedule_batch_fast(
+        ns_sh, carry_sh, batch, w, force_fast=True
+    )
+    np.testing.assert_array_equal(nodes_ref, nodes_sh)
+    np.testing.assert_array_equal(reasons_ref, reasons_sh)
+    for name in carry_ref._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(carry_ref, name)),
+            np.asarray(getattr(carry_out, name)),
+            err_msg=f"carry field {name}",
+        )

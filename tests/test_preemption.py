@@ -190,3 +190,124 @@ def test_end_to_end_no_preemption_for_priorityless_pod():
     result = simulate(cluster, [app])
     assert len(result.unscheduled) == 1
     assert not result.preempted
+
+
+# ---------------------------------------------------------------------------
+# device-filter-backed victim feasibility (Simulator._device_fits)
+# ---------------------------------------------------------------------------
+
+def test_device_fits_sees_anti_affinity_where_host_model_cannot():
+    """Node A looks preemptible under the resources-only host model (evicting
+    its low-priority pod frees enough cpu) and wins the host tiebreak with
+    fewer victims — but a higher-priority pod labeled app=guard stays on A
+    and the preemptor carries required anti-affinity against it, so the real
+    filters reject A post-eviction (selectVictimsOnNode's filter dry run,
+    default_preemption.go:598-626). The kernel-backed fits must route the
+    preemption to node B instead."""
+    node_a = mknode("a", cpu="4")
+    node_b = mknode("b", cpu="4")
+    for n in (node_a, node_b):
+        n.meta.labels["kubernetes.io/hostname"] = n.meta.name
+
+    guard = mkpod("guard", cpu="500m", priority=1000, labels={"app": "guard"})
+    victim_a = mkpod("victim-a", cpu="3", priority=1)
+    victim_b1 = mkpod("victim-b1", cpu="1500m", priority=1)
+    victim_b2 = mkpod("victim-b2", cpu="1500m", priority=1)
+
+    preemptor = mkpod("pre", cpu="3", priority=100)
+    preemptor.affinity.anti_required = Pod.from_dict(
+        {
+            "metadata": {"name": "proto", "namespace": "default"},
+            "spec": {
+                "containers": [{"name": "c", "image": "i"}],
+                "affinity": {
+                    "podAntiAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": [
+                            {
+                                "labelSelector": {
+                                    "matchLabels": {"app": "guard"}
+                                },
+                                "topologyKey": "kubernetes.io/hostname",
+                            }
+                        ]
+                    }
+                },
+            },
+        }
+    ).affinity.anti_required
+
+    # host-only model sanity: it WOULD nominate A (fewer victims)
+    wrong = try_preempt(
+        preemptor,
+        [node_a, node_b],
+        {
+            "a": bound("a", guard, victim_a),
+            "b": bound("b", victim_b1, victim_b2),
+        },
+        [],
+    )
+    assert wrong is not None and wrong.node == "a"
+
+    # end-to-end through the engine: device filters veto A, B's victims go
+    cluster = ClusterResource(
+        nodes=[node_a, node_b],
+        pods=bound("a", guard, victim_a)
+        + bound("b", victim_b1, victim_b2)
+        + [preemptor],
+    )
+    result = simulate(cluster, [])
+    assert not result.unscheduled
+    assert {p.pod.meta.name for p in result.preempted} == {
+        "victim-b1", "victim-b2"
+    }
+    placed = {
+        p.meta.name: st.node.name
+        for st in result.node_status
+        for p in st.pods
+    }
+    assert placed["pre"] == "b"
+    assert placed["guard"] == "a"
+    assert placed["victim-a"] == "a"
+
+
+def test_device_fits_eviction_clears_anti_affinity_conflict():
+    """The victim ITSELF carries the label the preemptor's required
+    anti-affinity rejects: hypothetically evicting it must CLEAR the
+    selector count at the node (a sign error doubles it instead), making
+    the node feasible and the preemption succeed."""
+    node = mknode("solo", cpu="4")
+    node.meta.labels["kubernetes.io/hostname"] = "solo"
+
+    victim = mkpod("victim", cpu="3", priority=1, labels={"app": "bad"})
+    preemptor = mkpod("pre", cpu="3", priority=100)
+    preemptor.affinity.anti_required = Pod.from_dict(
+        {
+            "metadata": {"name": "proto", "namespace": "default"},
+            "spec": {
+                "containers": [{"name": "c", "image": "i"}],
+                "affinity": {
+                    "podAntiAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": [
+                            {
+                                "labelSelector": {"matchLabels": {"app": "bad"}},
+                                "topologyKey": "kubernetes.io/hostname",
+                            }
+                        ]
+                    }
+                },
+            },
+        }
+    ).affinity.anti_required
+
+    cluster = ClusterResource(
+        nodes=[node], pods=bound("solo", victim) + [preemptor]
+    )
+    result = simulate(cluster, [])
+    assert not result.unscheduled
+    assert [p.pod.meta.name for p in result.preempted] == ["victim"]
+    placed = {
+        p.meta.name: st.node.name
+        for st in result.node_status
+        for p in st.pods
+    }
+    assert placed == {"pre": "solo"}
