@@ -37,8 +37,15 @@ def _probe_backend(platform: str, timeout_s: float) -> tuple[bool, str]:
         env["JAX_PLATFORMS"] = platform
     else:
         env.pop("JAX_PLATFORMS", None)
+    # the image's site hook overrides the env var after import; config.update
+    # is authoritative (utils/platform.ensure_platform)
+    select = (
+        f"import jax; jax.config.update('jax_platforms', {platform!r}); "
+        if platform
+        else "import jax; "
+    )
     code = (
-        "import jax; d = jax.devices(); "
+        select + "d = jax.devices(); "
         "import jax.numpy as jnp; jnp.zeros(8).block_until_ready(); "
         "print(d[0].platform, len(d))"
     )
@@ -397,9 +404,11 @@ def config_plan(n_pods=100_000, n_nodes=10_000):
         ClusterResource,
     )
 
+    # Sized so the workload genuinely overflows (~37.5k cpu demand vs ~30k
+    # capacity at full scale) and the add-node search must bracket + bisect.
     nodes = [
         _mk_node(
-            f"n-{i}", "16", "32Gi",
+            f"n-{i}", "3", "6Gi",
             labels={"topology.kubernetes.io/zone": f"az-{i % 3}"},
         )
         for i in range(n_nodes)
@@ -460,12 +469,14 @@ def main() -> int:
 
     import jax
 
-    if backend_info.get("fallback") == "cpu":
-        from open_simulator_tpu.utils.platform import ensure_platform
+    from open_simulator_tpu.utils.platform import (
+        enable_compilation_cache,
+        ensure_platform,
+    )
 
-        ensure_platform()
-    from open_simulator_tpu.utils.platform import enable_compilation_cache
-
+    # make the (possibly fallback-adjusted) JAX_PLATFORMS stick despite the
+    # image's site hook re-registering the TPU tunnel as default
+    ensure_platform()
     enable_compilation_cache()
 
     from open_simulator_tpu.ops.fast import schedule_batch_fast
@@ -521,8 +532,16 @@ def main() -> int:
     if args.quick:
         wanted = []
     if wanted:
+        # The heavy configs are sized for the TPU; on a CPU backend (fallback
+        # OR natively selected) they would run for tens of minutes and could
+        # stall the whole bench.
+        heavy = {"spread_aff_10k_1k", "plan_100k_10k"}
+        on_cpu = jax.devices()[0].platform == "cpu"
         configs_out = {}
         for name in wanted:
+            if on_cpu and name in heavy:
+                configs_out[name] = {"skipped": "cpu fallback (TPU-sized config)"}
+                continue
             print(f"bench config {name}...", file=sys.stderr, flush=True)
             try:
                 configs_out[name] = CONFIGS[name]()

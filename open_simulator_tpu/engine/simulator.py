@@ -399,7 +399,7 @@ class Simulator:
         return failed
 
     # -- preemption (PostFilter) -------------------------------------------
-    def _device_fits(self):
+    def _device_fits(self, bound_by_node):
         """fits_fn for victim selection that runs the REAL filter kernel on
         the candidate node's post-eviction state (parity:
         selectVictimsOnNode's dry run of the filter plugins,
@@ -440,7 +440,11 @@ class Simulator:
 
         row_cache: Dict[str, object] = {}
 
-        name_index = {name: i for i, name in enumerate(self._table.names)}
+        if not hasattr(self, "_name_index"):
+            self._name_index = {
+                name: i for i, name in enumerate(self._table.names)
+            }
+        name_index = self._name_index
 
         def fits(pod: Pod, node, remaining) -> bool:
             ni = name_index[node.name]
@@ -461,9 +465,7 @@ class Simulator:
             # Node column with ONLY `remaining` of the node's bound pods:
             # start from the current carry column and reverse the
             # contributions of the pods being hypothetically evicted.
-            on_node = [
-                p for p, name in self._bound if name == node.name
-            ]
+            on_node = bound_by_node.get(node.name, [])
             keep_ids = {id(p) for p in remaining}
             cols = {
                 "free": np.asarray(self._carry.free[ni]).copy(),
@@ -527,6 +529,7 @@ class Simulator:
 
         still_failed: List[UnscheduledPod] = []
         bound_by_node: Optional[Dict[str, List[Pod]]] = None
+        fits_fn = None
         for u in failed:
             pod = u.pod
             if pod.priority <= 0:
@@ -536,9 +539,10 @@ class Simulator:
                 bound_by_node = {}
                 for p, node_name in self._bound:
                     bound_by_node.setdefault(node_name, []).append(p)
+                fits_fn = self._device_fits(bound_by_node)
             res = try_preempt(
                 pod, self.cluster.nodes, bound_by_node, self._pdbs,
-                fits_fn=self._device_fits(),
+                fits_fn=fits_fn,
             )
             if res is None or not res.victims:
                 still_failed.append(u)
