@@ -73,6 +73,13 @@ from .state import pod_rows_from_batch
 # request; the [J,N,R] trajectory would not be worth its HBM footprint).
 J_CAP = 512
 
+# Path counters (tests/diagnostics): groups scheduled per strategy since
+# import. The sort path must actually fire for plain groups — parity alone
+# can't tell which path produced the result.
+PATH_COUNTS = {
+    "sort": 0, "micro": 0, "scan": 0, "grouped": 0, "sort_fallback": 0,
+}
+
 
 # Channel layout of Trajectory.packed — everything the selection step needs,
 # in one array so the whole per-step state fits a small [N,CH] matrix.
@@ -201,6 +208,9 @@ class GroupFlags(NamedTuple):
     any_req_aff: bool    # required (anti)affinity terms
     any_pref_aff: bool   # preferred (anti)affinity terms
     any_anti_sym: bool   # existing anti-affinity terms repel this pod
+    # soft spread is the ONLY carry-coupled term and uses non-hostname keys:
+    # the selection step reduces to partial9 + w*spread (the micro body)
+    micro_spread: bool = False
 
 
 ALL_DYNAMIC = GroupFlags(*([True] * 8))
@@ -209,17 +219,31 @@ ALL_DYNAMIC = GroupFlags(*([True] * 8))
 def group_flags(row_np: dict, anti_topo_np: np.ndarray) -> GroupFlags:
     """Derive GroupFlags from one pod's numpy feature row."""
     spread_active = row_np["spread_topo"] >= 0
+    soft = spread_active & ~row_np["spread_hard"]
     aff_active = row_np["aff_topo"] >= 0
-    return GroupFlags(
+    f = GroupFlags(
         dyn_ports=bool((row_np["hp_pid"] > 0).any()),
         dyn_storage=bool(row_np["has_local"]),
         dyn_gpu=bool(row_np["gpu_mem"] > 0),
         any_hard_spread=bool((spread_active & row_np["spread_hard"]).any()),
-        any_soft_spread=bool((spread_active & ~row_np["spread_hard"]).any()),
+        any_soft_spread=bool(soft.any()),
         any_req_aff=bool((aff_active & row_np["aff_required"]).any()),
         any_pref_aff=bool((aff_active & ~row_np["aff_required"]).any()),
         any_anti_sym=bool(((anti_topo_np >= 0) & row_np["match_anti"]).any()),
     )
+    micro = (
+        f.any_soft_spread
+        and not f.any_hard_spread
+        and not f.any_req_aff
+        and not f.any_pref_aff
+        and not f.any_anti_sym
+        and not f.dyn_gpu
+        and not f.dyn_storage
+        # hostname-keyed constraints count per node, not per domain — they
+        # keep the general body
+        and bool((row_np["spread_topo"][soft] > 0).all())
+    )
+    return f._replace(micro_spread=micro)
 
 
 def _light_eval(
@@ -338,15 +362,7 @@ def _light_eval(
     )
 
     # Dynamic scores (mirror kernels.score_* on the reconstructed state)
-    alloc2 = ns.alloc[:, :2]
-    free_after = free2 - pod.req[None, :2]
-    frac = jnp.where(alloc2 > 0, free_after / jnp.maximum(alloc2, 1e-9), 0.0)
-    la = jnp.clip(jnp.mean(frac, axis=1), 0.0, 1.0) * 100.0
-
-    used_after = ns.alloc[:, :2] - free2 + pod.req[None, :2]
-    frac_b = jnp.where(alloc2 > 0, used_after / jnp.maximum(alloc2, 1e-9), 0.0)
-    frac_b = jnp.clip(frac_b, 0.0, 1.0)
-    ba = (1.0 - jnp.abs(frac_b[:, 0] - frac_b[:, 1])) * 100.0
+    la, ba = _la_ba(ns, pod, free2)
 
     if flags.any_soft_spread:
         def one_ssc(topo_idx, sel_idx, hard):
@@ -418,6 +434,128 @@ def _light_eval(
     return score, parts
 
 
+def _la_ba(ns: NodeStatic, pod: PodRow, free2: jnp.ndarray):
+    """LeastAllocated + BalancedAllocation from cpu/mem free values — the one
+    definition all fast paths share (free2 is [N,2] or [N,J,2]; the ops are
+    elementwise, so every lane is bit-identical to the per-step kernel)."""
+    alloc2 = ns.alloc[:, :2]
+    req2 = pod.req[:2]
+    if free2.ndim == 3:
+        alloc2 = alloc2[:, None, :]
+        req2 = req2[None, None, :]
+    else:
+        req2 = req2[None, :]
+    free_after = free2 - req2
+    frac = jnp.where(alloc2 > 0, free_after / jnp.maximum(alloc2, 1e-9), 0.0)
+    la = jnp.clip(jnp.mean(frac, axis=-1), 0.0, 1.0) * 100.0
+    used_after = alloc2 - free2 + req2
+    frac_b = jnp.where(alloc2 > 0, used_after / jnp.maximum(alloc2, 1e-9), 0.0)
+    frac_b = jnp.clip(frac_b, 0.0, 1.0)
+    ba = (1.0 - jnp.abs(frac_b[..., 0] - frac_b[..., 1])) * 100.0
+    return la, ba
+
+
+def _sortable(flags: GroupFlags) -> bool:
+    """A group is sort-path eligible when every score/mask is a function of
+    the node's OWN commit count alone: no spread/affinity terms (they couple
+    through domain counts) and no GPU/storage volumes (their scores are
+    min-max normalized over the batch's CURRENT raw values, which change as
+    other nodes commit). Host ports are fine — purely node-local."""
+    return not (
+        flags.any_hard_spread
+        or flags.any_soft_spread
+        or flags.any_req_aff
+        or flags.any_pref_aff
+        or flags.any_anti_sym
+        or flags.dyn_gpu
+        or flags.dyn_storage
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("out_size",))
+def sort_select(
+    ns: NodeStatic,
+    traj: Trajectory,
+    pod: PodRow,
+    static_ok: jnp.ndarray,
+    static_scores: dict,
+    weights: jnp.ndarray,
+    valid_count: jnp.ndarray,
+    out_size: int,
+    filter_on=None,
+):
+    """Whole-group selection in ONE pass for sort-eligible groups.
+
+    With purely node-local scores, the sequential argmax is a k-way merge of
+    each node's (non-increasing) score sequence — i.e. the globally sorted
+    order of all [N,J] trajectory entries. A STABLE descending sort on the
+    row-major flattening reproduces the scan's tie-breaks exactly: equal
+    scores resolve to the lowest flat index = lowest node index first (the
+    scan's first-max argmax), and within a node to increasing commit count
+    (forced by sequence order anyway).
+
+    Returns (mono_ok, nodes i32[out_size], jidx i32[out_size], x i32[N]).
+    mono_ok is False when some node's score sequence INCREASES at a step
+    (balanced-allocation can rise while least-allocated falls); the caller
+    must then discard this result and take the scan path — the merge
+    argument needs non-increasing rows."""
+    N, J, _ = traj.packed.shape
+    fo = jnp.ones(NUM_FILTERS, bool) if filter_on is None else filter_on
+
+    free2 = traj.packed[:, :, CH_CPU:CH_MEM + 1]          # [N,J,2]
+    res_fail = traj.packed[:, :, CH_RES_FAIL] > 0.5
+    port_ok = (traj.packed[:, :, CH_PORT_OK] > 0.5) | ~fo[F_NODE_PORTS]
+    storage_ok = traj.packed[:, :, CH_STO_OK] > 0.5
+    gpu_ok = traj.packed[:, :, CH_GPU_OK] > 0.5
+    mask = (
+        static_ok[:, None] & port_ok & ~res_fail & storage_ok & gpu_ok
+        & ns.valid[:, None]
+    )                                                      # [N,J]
+
+    # Dynamic node-local scores, same expressions as _light_eval broadcast
+    # over the commit axis (elementwise => bit-identical per entry).
+    la, ba = _la_ba(ns, pod, free2)
+
+    def bcast(v):
+        return jnp.broadcast_to(v[:, None], (N, J))
+
+    # gpu_free is frozen for a non-GPU group, so the gpu-share score is its
+    # entry-state normalize (same value at every lane)
+    gpu_score = _minmax_normalize(traj.packed[:, 0, CH_GPU_RAW], ns.valid)
+    by_name = {
+        "balanced_allocation": ba,
+        "least_allocated": la,
+        "topology_spread": jnp.full((N, J), 100.0),  # no soft constraints
+        "inter_pod_affinity": jnp.zeros((N, J)),     # no preferred terms
+        "gpu_share": bcast(gpu_score),               # gpu_free frozen
+        "open_local": jnp.zeros((N, J)),             # no local volumes
+        **{k: bcast(v) for k, v in static_scores.items()},
+    }
+    stacked = jnp.stack([by_name[k] for k in WEIGHT_ORDER], axis=0)  # [W,N,J]
+    score = jnp.sum(stacked * weights[:, None, None], axis=0)
+    score = jnp.where(mask, score, -jnp.inf)
+
+    mono_ok = jnp.all(score[:, 1:] <= score[:, :-1])
+
+    flat = score.reshape(-1)
+    order = jnp.argsort(-flat, stable=True)[:out_size]
+    sel_score = flat[order]
+    feasible = jnp.isfinite(sel_score) & (jnp.arange(out_size) < valid_count)
+    sel_n = (order // J).astype(jnp.int32)
+    sel_j = (order % J).astype(jnp.int32)
+    nodes = jnp.where(feasible, sel_n, -1)
+    jidx = jnp.where(feasible, sel_j, 0)
+    x = jnp.zeros(N, jnp.int32).at[sel_n].add(feasible.astype(jnp.int32))
+    return mono_ok, nodes, jidx, x
+
+
+@jax.jit
+def cur_at(traj: Trajectory, x: jnp.ndarray) -> jnp.ndarray:
+    """packed[n, x_n] for every node (reason attribution after a sort-path
+    group needs the final-state channels)."""
+    return _sel_j(traj.packed, _x_onehot(x, traj.packed.shape[1]))
+
+
 def _hoisted_values(ns: NodeStatic, cur: jnp.ndarray, flags: GroupFlags) -> dict:
     """Group-invariant values _light_eval needs, computed once per jit call
     (outside the scan body). For a non-GPU group gpu_free never changes, so
@@ -427,6 +565,13 @@ def _hoisted_values(ns: NodeStatic, cur: jnp.ndarray, flags: GroupFlags) -> dict
     if not flags.dyn_gpu:
         out["gpu_score"] = _minmax_normalize(cur[:, CH_GPU_RAW], ns.valid)
     return out
+
+
+SP_IDX = WEIGHT_ORDER.index("topology_spread")
+assert SP_IDX == len(WEIGHT_ORDER) - 1, (
+    "the micro body's partial9 + w*spread split needs topology_spread LAST "
+    "in the stack-sum order"
+)
 
 
 @functools.partial(jax.jit, static_argnames=("group_size", "flags"))
@@ -440,7 +585,6 @@ def light_scan(
     na_ok: jnp.ndarray,
     weights: jnp.ndarray,
     x0: jnp.ndarray,
-    cur0: jnp.ndarray,
     offset: jnp.ndarray,
     group_size: int,
     valid_count: jnp.ndarray,
@@ -448,20 +592,34 @@ def light_scan(
     flags: GroupFlags = ALL_DYNAMIC,
 ):
     """Select nodes for `group_size` pods of the group, starting from commit
-    state (x0, cur0) — chunks of one group thread both through. Only steps
-    with offset + i < valid_count commit. Returns (x, cur, nodes i32[G],
-    jidx i32[G]).
+    state x0 (chunks of one group thread x through; everything else is
+    reconstructed from x at chunk start). Only steps with offset + i <
+    valid_count commit. Returns (x, nodes i32[G], jidx i32[G]).
 
     The scan carry keeps `cur` = packed[n, x_n] for every node (invariant:
     a commit only advances the chosen node's lane, so one dynamic row update
     per step maintains it) — the step never re-reads the [N,J,*] trajectory.
     Failure reasons are NOT computed per step: an infeasible step commits
     nothing, so the state freezes and every later step of the group fails
-    identically — light_reasons attributes the whole failure suffix once."""
+    identically — light_reasons attributes the whole failure suffix once.
+
+    flags.micro_spread selects the MICRO body: when soft non-hostname spread
+    is the only carry-coupled term, the 9 other score rows are hoisted into
+    a per-lane partial sum and the step is `partial9 + w_sp * spread` — a
+    bit-exact split of the [W,N] stack-sum because topology_spread is the
+    LAST summand (XLA's axis-0 reduce is a sequential left fold; asserted
+    at import and proven by the oracle parity suite)."""
     N = ns.valid.shape[0]
     j_steps = traj.packed.shape[1]
     fo = jnp.ones(NUM_FILTERS, bool) if filter_on is None else filter_on
+    cur0 = _sel_j(traj.packed, _x_onehot(x0, j_steps))
     hoisted = _hoisted_values(ns, cur0, flags)
+
+    if flags.micro_spread:
+        return _light_scan_micro(
+            ns, traj, carry0, pod, static_ok, static_scores, na_ok, weights,
+            x0, offset, group_size, valid_count, fo, flags,
+        )
 
     def step(carry_xc, i):
         x, cur = carry_xc
@@ -488,10 +646,115 @@ def light_scan(
 
         return (x2, cur2), (node_out.astype(jnp.int32), jidx.astype(jnp.int32))
 
-    (x_final, cur_final), (nodes, jidxs) = jax.lax.scan(
+    (x_final, _), (nodes, jidxs) = jax.lax.scan(
         step, (x0, cur0), jnp.arange(group_size)
     )
-    return x_final, cur_final, nodes, jidxs
+    return x_final, nodes, jidxs
+
+
+def _light_scan_micro(
+    ns, traj, carry0, pod, static_ok, static_scores, na_ok, weights,
+    x0, offset, group_size, valid_count, fo, flags,
+):
+    """The soft-spread micro body (see light_scan docstring). Traced inside
+    light_scan's jit; everything here but the scan body is loop-invariant."""
+    N = ns.valid.shape[0]
+    j_steps = traj.packed.shape[1]
+    D = ns.topo_onehot.shape[1]
+
+    # partial9 per (node, lane): every score row except topology_spread,
+    # stacked and summed in WEIGHT_ORDER exactly like the general body
+    free2 = traj.packed[:, :, CH_CPU:CH_MEM + 1]
+    la, ba = _la_ba(ns, pod, free2)
+    gpu_score = _minmax_normalize(traj.packed[:, 0, CH_GPU_RAW], ns.valid)
+
+    def bcast(v):
+        return jnp.broadcast_to(v[:, None], (N, j_steps))
+
+    by_name = {
+        "balanced_allocation": ba,
+        "least_allocated": la,
+        "inter_pod_affinity": jnp.zeros((N, j_steps)),
+        "gpu_share": bcast(gpu_score),
+        "open_local": jnp.zeros((N, j_steps)),
+        **{k: bcast(v) for k, v in static_scores.items()},
+    }
+    rows9 = jnp.stack(
+        [by_name[k] for k in WEIGHT_ORDER if k != "topology_spread"], axis=0
+    )
+    p9 = jnp.sum(rows9 * weights[:SP_IDX, None, None], axis=0)    # [N,J]
+    w_sp = weights[SP_IDX]
+
+    # feasibility per lane (micro: ports/resources are the only dynamics)
+    feas = (
+        static_ok[:, None]
+        & ((traj.packed[:, :, CH_PORT_OK] > 0.5) | ~fo[F_NODE_PORTS])
+        & ~((traj.packed[:, :, CH_RES_FAIL] > 0.5) & fo[F_RESOURCES])
+        & ns.valid[:, None]
+    )                                                             # [N,J]
+    score_lane = jnp.where(feas, p9, -jnp.inf)                    # [N,J]
+
+    # spread tables (soft constraints, non-hostname keys)
+    active_c = (pod.spread_topo >= 0) & ~pod.spread_hard          # [C]
+    k_c = jnp.maximum(pod.spread_topo, 0)                         # [C]
+    to_c = ns.topo_onehot[k_c]                                    # [C,D,N]
+    elig_f = (na_ok & ns.valid).astype(jnp.float32)               # [N]
+    base_rows = carry0.sel_counts[pod.spread_sel]                 # [C,N]
+    match_c = pod.match_sel[pod.spread_sel].astype(jnp.float32)   # [C]
+    counts0 = jnp.where(elig_f > 0, base_rows, 0.0)               # [C,N]
+    base_dom = jnp.einsum(
+        "cdn,cn->cd", to_c, counts0, precision=jax.lax.Precision.HIGHEST
+    )                                                             # [C,D]
+    xf0 = x0.astype(jnp.float32)
+    y0 = jnp.einsum(
+        "cdn,n->cd", to_c, elig_f * xf0,
+        precision=jax.lax.Precision.HIGHEST,
+    )                                                             # [C,D]
+    # select p9 and feasibility SEPARATELY: _sel_j's one-hot multiply would
+    # turn score_lane's -inf entries into NaN (-inf * 0.0) on unselected lanes
+    oh0 = _x_onehot(x0, j_steps)
+    cur_s0 = jnp.where(
+        _sel_j(feas, oh0), _sel_j(p9, oh0), -jnp.inf
+    )                                                             # [N]
+
+    def step(carry_xy, i):
+        x, cur_s, y = carry_xy
+        active = (offset + i) < valid_count
+        dom = base_dom + match_c[:, None] * y                     # [C,D]
+        cnt = jnp.einsum(
+            "cd,cdn->cn", dom, to_c, precision=jax.lax.Precision.HIGHEST
+        )                                                         # [C,N]
+        raw = jnp.sum(jnp.where(active_c[:, None], cnt, 0.0), axis=0)
+        mx = jnp.max(jnp.where(ns.valid, raw, 0.0))
+        sp = jnp.where(
+            mx > 0, (mx - raw) * 100.0 / jnp.maximum(mx, 1e-9), 100.0
+        )
+        score = cur_s + w_sp * sp                                 # -inf stays
+        node = jnp.argmax(score)
+        ok = (score[node] > -jnp.inf) & active
+        node_out = jnp.where(ok, node, -1)
+        jidx = jnp.where(ok, x[node], 0)
+
+        onehot = (jnp.arange(N) == node) & ok
+        x2 = x + onehot.astype(jnp.int32)
+        j_next = jnp.clip(x[node] + 1, 0, j_steps - 1)
+        new_s = jax.lax.dynamic_slice(score_lane, (node, j_next), (1, 1))
+        new_s = jnp.where(ok, new_s, cur_s[node][None, None])
+        cur_s2 = jax.lax.dynamic_update_slice(cur_s[:, None], new_s, (node, 0))[
+            :, 0
+        ]
+        to_col = jax.lax.dynamic_slice(to_c, (0, 0, node), (to_c.shape[0], D, 1))
+        y2 = y + to_col[:, :, 0] * (
+            elig_f[node] * ok.astype(jnp.float32)
+        )
+        return (x2, cur_s2, y2), (
+            node_out.astype(jnp.int32), jidx.astype(jnp.int32)
+        )
+
+    (x_final, _, _), (nodes, jidxs) = jax.lax.scan(
+        step, (x0, cur_s0, y0), jnp.arange(group_size)
+    )
+    return x_final, nodes, jidxs
 
 
 @functools.partial(jax.jit, static_argnames=("flags",))
@@ -690,6 +953,7 @@ def schedule_batch_fast(
             and (force_fast or length >= max(2 * j_need, 64))
         )
         if not use_fast:
+            PATH_COUNTS["grouped"] += 1
             done = 0
             while done < length:
                 n = min(length - done, max_group_chunk)
@@ -711,24 +975,48 @@ def schedule_batch_fast(
         traj, static_ok, static_ff, static_scores, na_ok = build_trajectory(
             ns, carry, row, weights, j_steps, filter_on
         )
-        x = jnp.zeros(N, jnp.int32)
-        cur = traj.packed[:, 0, :]
-        chunks = []
-        done = 0
-        while done < length:
-            n = min(length - done, max_group_chunk)
-            g = _bucket_light(n)
-            x, cur, nodes, jidxs = light_scan(
-                ns, traj, carry, row, static_ok, static_scores,
-                na_ok, weights, x, cur, jnp.int32(done), g,
-                jnp.int32(length), filter_on, flags,
+
+        # Sort path: whole group in one device call when scores are purely
+        # node-local and per-node non-increasing (checked on device).
+        sorted_ok = False
+        out_size = _bucket_light(length)
+        if _sortable(flags) and out_size <= N * j_steps:
+            mono, nodes_d, jidx_d, x = sort_select(
+                ns, traj, row, static_ok, static_scores, weights,
+                jnp.int32(length), out_size, filter_on,
             )
-            chunks.append((n, nodes, jidxs))
-            done += n
-        # One transfer per group (per-chunk np.asarray syncs dominated the
-        # host-side cost at TPU-tunnel latencies).
-        nodes_d = jnp.concatenate([c[1][: c[0]] for c in chunks])
-        jidx_d = jnp.concatenate([c[2][: c[0]] for c in chunks])
+            if bool(mono):
+                sorted_ok = True
+                nodes_d = nodes_d[:length]
+                jidx_d = jidx_d[:length]
+            else:
+                # a balanced-allocation rise broke monotonicity — the merge
+                # argument doesn't hold, replay with the scan below
+                PATH_COUNTS["sort_fallback"] += 1
+
+        if sorted_ok:
+            PATH_COUNTS["sort"] += 1
+        else:
+            PATH_COUNTS["micro" if flags.micro_spread else "scan"] += 1
+            x = jnp.zeros(N, jnp.int32)
+            chunks = []
+            done = 0
+            while done < length:
+                n = min(length - done, max_group_chunk)
+                g = _bucket_light(n)
+                x, nodes, jidxs = light_scan(
+                    ns, traj, carry, row, static_ok, static_scores,
+                    na_ok, weights, x, jnp.int32(done), g,
+                    jnp.int32(length), filter_on, flags,
+                )
+                chunks.append((n, nodes, jidxs))
+                done += n
+            # One transfer per group (per-chunk np.asarray syncs dominated
+            # the host-side cost at TPU-tunnel latencies).
+            nodes_d = jnp.concatenate([c[1][: c[0]] for c in chunks])
+            jidx_d = jnp.concatenate([c[2][: c[0]] for c in chunks])
+
+        # shared tail: takes, output writes, failure-suffix reasons, carry
         take_d, vg_d, dev_d = gather_takes(traj, nodes_d, jidx_d)
         sl = slice(start, start + length)
         nodes_np = np.asarray(nodes_d)
@@ -742,7 +1030,7 @@ def schedule_batch_fast(
             reason_row = np.asarray(
                 light_reasons(
                     ns, carry, row, static_ok, static_ff, static_scores,
-                    na_ok, weights, x, cur, filter_on, flags,
+                    na_ok, weights, x, cur_at(traj, x), filter_on, flags,
                 )
             )
             reasons_out[sl][nodes_np < 0] = reason_row

@@ -66,11 +66,13 @@ def _assert_identical(ns, carry0, batch, force_fast=True):
 
 
 def _encode(nodes, templates, counts, bound=()):
+    from open_simulator_tpu.ops.encode import aggregate_usage
+
     enc = Encoder()
     enc.register_pods(templates)
     for pod, _ in bound:
         enc.register_pods([pod])
-    table = encode_nodes(enc, nodes)
+    table = encode_nodes(enc, nodes, existing_usage=aggregate_usage(list(bound)))
     batch = tile_pod_batch(encode_pods(enc, templates), counts)
     ns = node_static_from_table(enc, table)
     carry = carry_from_table(
@@ -454,3 +456,77 @@ def test_fast_filter_disable_parity_when_fast():
     np.testing.assert_array_equal(np.asarray(nodes_ref)[:total], nodes_f[:total])
     np.testing.assert_array_equal(np.asarray(reasons_ref)[:total], reasons_f[:total])
     assert (nodes_f[:total] >= 0).all()  # port conflicts no longer filter
+
+
+def test_sort_path_fires_for_plain_groups():
+    """Groups with purely node-local scoring must take the one-sort path
+    (PATH_COUNTS proves which strategy ran; parity alone cannot)."""
+    from open_simulator_tpu.ops import fast
+
+    nodes = [_node(f"n-{i}", cpu="16", pods="12") for i in range(6)]
+    tmpl = _pod("t", cpu="500m")
+    ns, carry, batch = _encode(nodes, [tmpl], [60])
+    before = dict(fast.PATH_COUNTS)
+    _assert_identical(ns, carry, batch)
+    assert fast.PATH_COUNTS["sort"] > before["sort"]
+
+
+def test_sort_path_monotonicity_fallback_is_exact():
+    """A pod whose balanced-allocation gain outweighs its least-allocated
+    loss produces an INCREASING score sequence — the sort path must detect
+    it (mono check) and the scan fallback must stay exact.
+
+    Nodes are memory-saturated by bound pods (memfrac ~0.9, cpufrac ~0.01);
+    each cpu-heavy incoming pod narrows |cpufrac - memfrac| by ~0.09 while
+    least-allocated drops only ~0.055 — the combined score rises."""
+    from open_simulator_tpu.ops import fast
+
+    nodes = [_node(f"n-{i}", cpu="10", mem="100Gi", pods="40") for i in range(4)]
+    hogs = []
+    for i, nd in enumerate(nodes):
+        hog = _pod(f"hog-{i}", cpu="100m", mem="90Gi")
+        hog.node_name = nd.meta.name
+        hogs.append((hog, nd.meta.name))
+    tmpl = _pod("t", cpu="1", mem="1Gi")
+    ns, carry, batch = _encode(nodes, [tmpl], [30], bound=hogs)
+    before = dict(fast.PATH_COUNTS)
+    _assert_identical(ns, carry, batch)
+    after = dict(fast.PATH_COUNTS)
+    assert after["sort_fallback"] > before["sort_fallback"], (
+        f"expected the mono check to trip; counters {after}"
+    )
+
+
+def test_micro_body_fires_for_soft_spread_groups():
+    """Soft non-hostname spread with no other coupling must take the micro
+    body (partial9 + w*spread), staying exact through domain block/unblock
+    and the overflow tail."""
+    from open_simulator_tpu.ops import fast
+
+    nodes = [
+        _node(
+            f"n-{i}", cpu="8", pods="10",
+            labels={"topology.kubernetes.io/zone": f"z-{i % 3}"},
+        )
+        for i in range(9)
+    ]
+    tmpl = _pod(
+        "t",
+        cpu="500m",
+        labels={"app": "soft"},
+        spec_extra={
+            "topologySpreadConstraints": [
+                {
+                    "maxSkew": 3,
+                    "topologyKey": "topology.kubernetes.io/zone",
+                    "whenUnsatisfiable": "ScheduleAnyway",
+                    "labelSelector": {"matchLabels": {"app": "soft"}},
+                }
+            ]
+        },
+    )
+    ns, carry, batch = _encode(nodes, [tmpl], [100])
+    before = dict(fast.PATH_COUNTS)
+    nodes_out = _assert_identical(ns, carry, batch)
+    assert fast.PATH_COUNTS["micro"] > before["micro"]
+    assert (nodes_out == -1).sum() > 0  # pods overflow the 9x10 slots
