@@ -58,6 +58,7 @@ from .kernels import (
     _EPS,
     _domain_counts,
     _minmax_normalize,
+    combine_scores,
     gpu_allocate_rowwise,
     gpu_mask,
     gpu_share_raw,
@@ -424,8 +425,7 @@ def _light_eval(
         ),
         **static_scores,
     }
-    stacked = jnp.stack([by_name[k] for k in WEIGHT_ORDER], axis=0)
-    score = jnp.sum(stacked * weights[:, None], axis=0)
+    score = combine_scores(by_name, weights)
     score = jnp.where(mask, score, -jnp.inf)
     parts = {
         "port_ok": port_ok, "res_fail": res_fail_x, "spread_ok": spread_ok,
@@ -453,6 +453,31 @@ def _la_ba(ns: NodeStatic, pod: PodRow, free2: jnp.ndarray):
     frac_b = jnp.clip(frac_b, 0.0, 1.0)
     ba = (1.0 - jnp.abs(frac_b[..., 0] - frac_b[..., 1])) * 100.0
     return la, ba
+
+
+def _lane_rows(
+    ns: NodeStatic, traj: Trajectory, pod: PodRow, static_scores: dict
+) -> dict:
+    """The nine node-local score rows per (node, lane) — shared by the sort
+    path and the micro body so the arithmetic can never drift between them.
+    Assumes gpu_free is frozen for the group (callers gate on !dyn_gpu) and
+    no storage volumes / preferred affinity terms."""
+    N, J, _ = traj.packed.shape
+    free2 = traj.packed[:, :, CH_CPU:CH_MEM + 1]
+    la, ba = _la_ba(ns, pod, free2)
+    gpu_score = _minmax_normalize(traj.packed[:, 0, CH_GPU_RAW], ns.valid)
+
+    def bcast(v):
+        return jnp.broadcast_to(v[:, None], (N, J))
+
+    return {
+        "balanced_allocation": ba,
+        "least_allocated": la,
+        "inter_pod_affinity": jnp.zeros((N, J)),
+        "gpu_share": bcast(gpu_score),
+        "open_local": jnp.zeros((N, J)),
+        **{k: bcast(v) for k, v in static_scores.items()},
+    }
 
 
 def _sortable(flags: GroupFlags) -> bool:
@@ -502,7 +527,6 @@ def sort_select(
     N, J, _ = traj.packed.shape
     fo = jnp.ones(NUM_FILTERS, bool) if filter_on is None else filter_on
 
-    free2 = traj.packed[:, :, CH_CPU:CH_MEM + 1]          # [N,J,2]
     res_fail = traj.packed[:, :, CH_RES_FAIL] > 0.5
     port_ok = (traj.packed[:, :, CH_PORT_OK] > 0.5) | ~fo[F_NODE_PORTS]
     storage_ok = traj.packed[:, :, CH_STO_OK] > 0.5
@@ -512,27 +536,9 @@ def sort_select(
         & ns.valid[:, None]
     )                                                      # [N,J]
 
-    # Dynamic node-local scores, same expressions as _light_eval broadcast
-    # over the commit axis (elementwise => bit-identical per entry).
-    la, ba = _la_ba(ns, pod, free2)
-
-    def bcast(v):
-        return jnp.broadcast_to(v[:, None], (N, J))
-
-    # gpu_free is frozen for a non-GPU group, so the gpu-share score is its
-    # entry-state normalize (same value at every lane)
-    gpu_score = _minmax_normalize(traj.packed[:, 0, CH_GPU_RAW], ns.valid)
-    by_name = {
-        "balanced_allocation": ba,
-        "least_allocated": la,
-        "topology_spread": jnp.full((N, J), 100.0),  # no soft constraints
-        "inter_pod_affinity": jnp.zeros((N, J)),     # no preferred terms
-        "gpu_share": bcast(gpu_score),               # gpu_free frozen
-        "open_local": jnp.zeros((N, J)),             # no local volumes
-        **{k: bcast(v) for k, v in static_scores.items()},
-    }
-    stacked = jnp.stack([by_name[k] for k in WEIGHT_ORDER], axis=0)  # [W,N,J]
-    score = jnp.sum(stacked * weights[:, None, None], axis=0)
+    by_name = dict(_lane_rows(ns, traj, pod, static_scores))
+    by_name["topology_spread"] = jnp.full((N, J), 100.0)  # no soft constraints
+    score = combine_scores(by_name, weights)
     score = jnp.where(mask, score, -jnp.inf)
 
     mono_ok = jnp.all(score[:, 1:] <= score[:, :-1])
@@ -570,7 +576,7 @@ def _hoisted_values(ns: NodeStatic, cur: jnp.ndarray, flags: GroupFlags) -> dict
 SP_IDX = WEIGHT_ORDER.index("topology_spread")
 assert SP_IDX == len(WEIGHT_ORDER) - 1, (
     "the micro body's partial9 + w*spread split needs topology_spread LAST "
-    "in the stack-sum order"
+    "in combine_scores' fold order"
 )
 
 
@@ -605,21 +611,21 @@ def light_scan(
 
     flags.micro_spread selects the MICRO body: when soft non-hostname spread
     is the only carry-coupled term, the 9 other score rows are hoisted into
-    a per-lane partial sum and the step is `partial9 + w_sp * spread` — a
-    bit-exact split of the [W,N] stack-sum because topology_spread is the
-    LAST summand (XLA's axis-0 reduce is a sequential left fold; asserted
-    at import and proven by the oracle parity suite)."""
+    a per-lane partial sum and the step is `partial9 + w_sp * spread` — an
+    exact split of combine_scores' explicit left fold because
+    topology_spread is the LAST summand (asserted at import)."""
     N = ns.valid.shape[0]
     j_steps = traj.packed.shape[1]
     fo = jnp.ones(NUM_FILTERS, bool) if filter_on is None else filter_on
-    cur0 = _sel_j(traj.packed, _x_onehot(x0, j_steps))
-    hoisted = _hoisted_values(ns, cur0, flags)
 
     if flags.micro_spread:
         return _light_scan_micro(
             ns, traj, carry0, pod, static_ok, static_scores, na_ok, weights,
             x0, offset, group_size, valid_count, fo, flags,
         )
+
+    cur0 = _sel_j(traj.packed, _x_onehot(x0, j_steps))
+    hoisted = _hoisted_values(ns, cur0, flags)
 
     def step(carry_xc, i):
         x, cur = carry_xc
@@ -663,26 +669,12 @@ def _light_scan_micro(
     D = ns.topo_onehot.shape[1]
 
     # partial9 per (node, lane): every score row except topology_spread,
-    # stacked and summed in WEIGHT_ORDER exactly like the general body
-    free2 = traj.packed[:, :, CH_CPU:CH_MEM + 1]
-    la, ba = _la_ba(ns, pod, free2)
-    gpu_score = _minmax_normalize(traj.packed[:, 0, CH_GPU_RAW], ns.valid)
-
-    def bcast(v):
-        return jnp.broadcast_to(v[:, None], (N, j_steps))
-
-    by_name = {
-        "balanced_allocation": ba,
-        "least_allocated": la,
-        "inter_pod_affinity": jnp.zeros((N, j_steps)),
-        "gpu_share": bcast(gpu_score),
-        "open_local": jnp.zeros((N, j_steps)),
-        **{k: bcast(v) for k, v in static_scores.items()},
-    }
-    rows9 = jnp.stack(
-        [by_name[k] for k in WEIGHT_ORDER if k != "topology_spread"], axis=0
-    )
-    p9 = jnp.sum(rows9 * weights[:SP_IDX, None, None], axis=0)    # [N,J]
+    # combined by the shared left fold — `p9 + w_sp * sp` then equals the
+    # full combine_scores result by construction (topology_spread is last).
+    p9 = combine_scores(
+        _lane_rows(ns, traj, pod, static_scores), weights,
+        order=WEIGHT_ORDER[:SP_IDX],
+    )                                                             # [N,J]
     w_sp = weights[SP_IDX]
 
     # feasibility per lane (micro: ports/resources are the only dynamics)

@@ -48,6 +48,7 @@ from .kernels import (
     WEIGHT_ORDER,
     _EPS,
     _minmax_normalize,
+    combine_scores,
     gpu_allocate,
     gpu_mask,
     local_storage_commit,
@@ -149,8 +150,9 @@ def schedule_group(
             & gpu_ok & extra_ok & ns.valid
         )
 
-        # Stack in WEIGHT_ORDER exactly like run_scores so the f32 summation
-        # order (and therefore every tie-break) matches the naive kernel.
+        # Combine in WEIGHT_ORDER exactly like run_scores so the f32
+        # summation order (and therefore every tie-break) matches the naive
+        # kernel.
         by_name = {
             "balanced_allocation": score_balanced(ns, c, pod),
             "least_allocated": score_least_allocated(ns, c, pod),
@@ -162,8 +164,7 @@ def schedule_group(
             ),
             **static_scores,
         }
-        stacked = jnp.stack([by_name[k] for k in WEIGHT_ORDER], axis=0)
-        score = jnp.sum(stacked * weights[:, None], axis=0)
+        score = combine_scores(by_name, weights)
         for fn, w in extra_scores:
             score = score + w * fn(ns, c, pod)
         score = jnp.where(mask, score, -jnp.inf)

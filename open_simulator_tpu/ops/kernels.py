@@ -104,6 +104,19 @@ def weights_array(weights: dict = DEFAULT_WEIGHTS) -> jnp.ndarray:
     return jnp.array([float(weights.get(k, 0.0)) for k in WEIGHT_ORDER], jnp.float32)
 
 
+def combine_scores(by_name: dict, weights: jnp.ndarray, order=WEIGHT_ORDER):
+    """Weighted score combination as an EXPLICIT left fold over `order`:
+    ((w0*s0 + w1*s1) + w2*s2) + ... — every scheduling path (naive scan,
+    grouped, light/sort/micro fast paths) uses this one function, so partial
+    sums split exactly: fold(order) == fold(order[:-1]) + w_last*s_last by
+    construction, with no reliance on XLA's reduce lowering."""
+    total = None
+    for i, k in enumerate(order):
+        term = weights[i] * by_name[k]
+        total = term if total is None else total + term
+    return total
+
+
 class NodeStatic(NamedTuple):
     """Immutable per-node tensors (device resident for a whole simulation)."""
     alloc: jnp.ndarray        # f32[N,R]
@@ -948,8 +961,7 @@ def run_scores(
         "gpu_share": score_gpu_share(ns, carry, pod),
         "open_local": score_open_local(ns, carry, pod),
     }
-    stacked = jnp.stack([by_name[k] for k in WEIGHT_ORDER], axis=0)  # [W,N]
-    score = jnp.sum(stacked * weights[:, None], axis=0)
+    score = combine_scores(by_name, weights)
     for fn, w in extra_scores:
         score = score + w * fn(ns, carry, pod)
     return score

@@ -1,0 +1,73 @@
+"""The functional-option fixture factories must produce objects the whole
+engine accepts (parity: the reference's pkg/test builders are used by its own
+runtime tests)."""
+
+from open_simulator_tpu.engine.simulator import (
+    AppResource,
+    ClusterResource,
+    simulate,
+)
+
+from factories import (
+    make_cronjob,
+    make_daemonset,
+    make_deployment,
+    make_job,
+    make_node,
+    make_pod,
+    make_statefulset,
+    spread_constraint,
+    taint,
+    toleration,
+)
+
+
+def test_factories_drive_full_simulation():
+    nodes = [
+        make_node(
+            f"n-{i}", cpu="16", memory="32Gi",
+            with_labels={"topology.kubernetes.io/zone": f"z{i % 2}"},
+            with_taints=[taint("dedicated", "batch")] if i == 0 else None,
+        )
+        for i in range(4)
+    ]
+    pending = make_pod("seed", cpu="1", with_labels={"app": "seed"})
+    apps = [
+        AppResource(
+            name="a",
+            objects=[
+                make_deployment(
+                    "web", replicas=4, cpu="500m",
+                    with_spread=[
+                        spread_constraint(
+                            "topology.kubernetes.io/zone",
+                            max_skew=2,
+                            when_unsatisfiable="ScheduleAnyway",
+                            match_labels={"app": "web"},
+                        )
+                    ],
+                ),
+                make_statefulset("db", replicas=2, cpu="1"),
+                make_job("once", completions=2, parallelism=2),
+                make_daemonset(
+                    "agent",
+                    with_tolerations=[
+                        toleration("dedicated", operator="Exists")
+                    ],
+                ),
+                make_cronjob("tick"),
+            ],
+        )
+    ]
+    res = simulate(ClusterResource(nodes=nodes, pods=[pending]), apps)
+    assert not res.unscheduled
+    placed = sum(len(st.pods) for st in res.node_status)
+    # web 4 + db 2 + job 2 + daemonset on every node 4 + cronjob 1 + seed 1
+    assert placed == 14
+    agent_nodes = {
+        st.node.name
+        for st in res.node_status
+        for p in st.pods
+        if p.meta.name.startswith("agent")
+    }
+    assert len(agent_nodes) == 4  # daemonset tolerated the taint everywhere
