@@ -166,9 +166,12 @@ def plan_capacity(
     lo, hi = 0, max(min(lower_bound_nodes(base, new_node), max_new_nodes), 1)
     hi_result = None
     while hi <= max_new_nodes:
+        # (exponential probes rely on encode_nodes' default round_up(n, 64)
+        # padding; only the bisection below needs an explicit pin, so every
+        # mid-probe shares the bracket's bucket)
         hi_result = _probe(
             cluster, apps, new_node, hi, weights, use_greed, mesh,
-            n_pad=round_up(n_base + hi), profiles=profiles,
+            profiles=profiles,
         )
         attempts += 1
         if good(hi_result):
@@ -178,7 +181,7 @@ def plan_capacity(
     else:
         return None
     best, best_result = hi, hi_result
-    n_pad = round_up(n_base + hi)
+    n_pad = round_up(n_base + hi, 64)
     while lo + 1 < hi:
         mid = (lo + hi) // 2
         res = _probe(

@@ -400,7 +400,11 @@ def encode_nodes(
     totals of already-bound pods (subtracted into `free`); existing_gpu maps
     node name -> used MiB per device (from aggregate_gpu_usage)."""
     n = len(nodes)
-    N = n_pad if n_pad is not None else round_up(n)
+    # Node-axis floor of 64: tiny clusters pay a few inert padded rows, and
+    # in exchange the whole jit family (scan/traj/light/sort) keeps ONE shape
+    # across interactive runs and most capacity-search probes — tracing the
+    # big scheduling graphs dominates small-cluster wall time otherwise.
+    N = n_pad if n_pad is not None else round_up(n, 64)
     R = len(enc.resources)
     L = round_up(max((len(nd.meta.labels) for nd in nodes), default=1), 4)
     T = round_up(max((len(nd.taints) for nd in nodes), default=1), 2)

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .encode import PodBatch
+from .encode import PodBatch, round_up
 from .kernels import (
     Carry,
     F_EXTRA,
@@ -303,9 +303,10 @@ def group_runs(batch: PodBatch) -> List[Tuple[int, int]]:
 
 
 def _bucket(n: int) -> int:
-    if n <= 4096:
-        return 1 << max(n - 1, 0).bit_length()
-    return (n + 4095) // 4096 * 4096
+    """Scan-length bucket. Floor of 32: distinct lengths below that would
+    each trace their own multi-second jit of the full scheduling graph for
+    under ~0.3s of wasted inert steps."""
+    return round_up(n, 32)
 
 
 def schedule_batch_grouped(
