@@ -96,7 +96,6 @@ def build_state(n_nodes: int, n_pods: int):
     from open_simulator_tpu.ops.state import (
         carry_from_table,
         node_static_from_table,
-        pod_rows_from_batch,
     )
     from open_simulator_tpu.ops.tile import tile_pod_batch
 

@@ -14,7 +14,6 @@ from __future__ import annotations
 from typing import Dict, Iterable, List, Optional
 
 from .objects import (
-    Affinity,
     LabelSelector,
     LabelSelectorRequirement,
     Node,

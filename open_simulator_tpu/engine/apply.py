@@ -10,10 +10,9 @@ search (engine/capacity.py) with interactive kept as an option.
 
 from __future__ import annotations
 
-import os
 import shutil
 import subprocess
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import List, Optional, TextIO
 
 from ..api.config import SimonConfig

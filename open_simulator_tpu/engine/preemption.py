@@ -33,7 +33,7 @@ re-syncs device state once per successful eviction round.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..core.matcher import (

@@ -8,8 +8,6 @@ placements grouped by workload, and unscheduled pods with reasons.
 
 from __future__ import annotations
 
-from typing import Dict, List
-
 from ..core.objects import (
     ANNO_WORKLOAD_KIND,
     ANNO_WORKLOAD_NAME,

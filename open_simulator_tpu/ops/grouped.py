@@ -22,7 +22,7 @@ are all static for a fixed pod spec).
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import List, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -33,20 +33,14 @@ from .kernels import (
     Carry,
     F_EXTRA,
     F_GPU,
-    F_NODE_AFFINITY,
-    F_NODE_NAME,
     F_NODE_PORTS,
     F_POD_AFFINITY,
     F_RESOURCES,
     F_SPREAD,
     F_STORAGE,
-    F_TAINT,
-    F_UNSCHEDULABLE,
     NUM_FILTERS,
     NodeStatic,
     PodRow,
-    WEIGHT_ORDER,
-    _EPS,
     _minmax_normalize,
     combine_scores,
     gpu_allocate,

@@ -25,7 +25,7 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import List, Optional
+from typing import Optional
 
 from ..core.objects import (
     ANNO_WORKLOAD_KIND,
