@@ -209,8 +209,9 @@ class GroupFlags(NamedTuple):
     any_req_aff: bool    # required (anti)affinity terms
     any_pref_aff: bool   # preferred (anti)affinity terms
     any_anti_sym: bool   # existing anti-affinity terms repel this pod
-    # soft spread is the ONLY carry-coupled term and uses non-hostname keys:
-    # the selection step reduces to partial9 + w*spread (the micro body)
+    # topology spread (soft and/or hard) is the ONLY carry-coupled term and
+    # uses non-hostname keys: the selection step reduces to partial9 +
+    # w*spread with a small domain-count carry (the micro body)
     micro_spread: bool = False
 
 
@@ -233,8 +234,7 @@ def group_flags(row_np: dict, anti_topo_np: np.ndarray) -> GroupFlags:
         any_anti_sym=bool(((anti_topo_np >= 0) & row_np["match_anti"]).any()),
     )
     micro = (
-        f.any_soft_spread
-        and not f.any_hard_spread
+        (f.any_soft_spread or f.any_hard_spread)
         and not f.any_req_aff
         and not f.any_pref_aff
         and not f.any_anti_sym
@@ -242,7 +242,7 @@ def group_flags(row_np: dict, anti_topo_np: np.ndarray) -> GroupFlags:
         and not f.dyn_storage
         # hostname-keyed constraints count per node, not per domain — they
         # keep the general body
-        and bool((row_np["spread_topo"][soft] > 0).all())
+        and bool((row_np["spread_topo"][spread_active] > 0).all())
     )
     return f._replace(micro_spread=micro)
 
@@ -609,11 +609,13 @@ def light_scan(
     nothing, so the state freezes and every later step of the group fails
     identically — light_reasons attributes the whole failure suffix once.
 
-    flags.micro_spread selects the MICRO body: when soft non-hostname spread
-    is the only carry-coupled term, the 9 other score rows are hoisted into
-    a per-lane partial sum and the step is `partial9 + w_sp * spread` — an
-    exact split of combine_scores' explicit left fold because
-    topology_spread is the LAST summand (asserted at import)."""
+    flags.micro_spread selects the MICRO body: when topology spread (soft
+    and/or hard, non-hostname keys) is the only carry-coupled term, the 9
+    other score rows are hoisted into a per-lane partial sum and the step is
+    `partial9 + w_sp * spread` (+ the DoNotSchedule skew mask from the same
+    reconstructed domain counts) — an exact split of combine_scores'
+    explicit left fold because topology_spread is the LAST summand
+    (asserted at import)."""
     N = ns.valid.shape[0]
     j_steps = traj.packed.shape[1]
     fo = jnp.ones(NUM_FILTERS, bool) if filter_on is None else filter_on
@@ -662,7 +664,7 @@ def _light_scan_micro(
     ns, traj, carry0, pod, static_ok, static_scores, na_ok, weights,
     x0, offset, group_size, valid_count, fo, flags,
 ):
-    """The soft-spread micro body (see light_scan docstring). Traced inside
+    """The topology-spread micro body (see light_scan docstring). Traced inside
     light_scan's jit; everything here but the scan body is loop-invariant."""
     N = ns.valid.shape[0]
     j_steps = traj.packed.shape[1]
@@ -686,8 +688,10 @@ def _light_scan_micro(
     )                                                             # [N,J]
     score_lane = jnp.where(feas, p9, -jnp.inf)                    # [N,J]
 
-    # spread tables (soft constraints, non-hostname keys)
+    # spread tables (non-hostname keys; soft rows feed the score, hard rows
+    # the mask — both share the per-row domain-count reconstruction)
     active_c = (pod.spread_topo >= 0) & ~pod.spread_hard          # [C]
+    hard_c = (pod.spread_topo >= 0) & pod.spread_hard             # [C]
     k_c = jnp.maximum(pod.spread_topo, 0)                         # [C]
     to_c = ns.topo_onehot[k_c]                                    # [C,D,N]
     elig_f = (na_ok & ns.valid).astype(jnp.float32)               # [N]
@@ -697,6 +701,12 @@ def _light_scan_micro(
     base_dom = jnp.einsum(
         "cdn,cn->cd", to_c, counts0, precision=jax.lax.Precision.HIGHEST
     )                                                             # [C,D]
+    if flags.any_hard_spread:
+        has_key_cn = (ns.topo[:, k_c] >= 0).T                     # [C,N]
+        dom_elig = jnp.einsum(
+            "cdn,n->cd", to_c, elig_f, precision=jax.lax.Precision.HIGHEST
+        ) > 0.0                                                   # [C,D]
+        in_key_cd = (ns.domain_key[None, :] == k_c[:, None]) & dom_elig
     xf0 = x0.astype(jnp.float32)
     y0 = jnp.einsum(
         "cdn,n->cd", to_c, elig_f * xf0,
@@ -722,6 +732,18 @@ def _light_scan_micro(
             mx > 0, (mx - raw) * 100.0 / jnp.maximum(mx, 1e-9), 100.0
         )
         score = cur_s + w_sp * sp                                 # -inf stays
+        if flags.any_hard_spread:
+            # DoNotSchedule skew check (mirror kernels.spread_mask via the
+            # reconstructed dom — integer-exact, so bit-identical)
+            min_dom = jnp.min(jnp.where(in_key_cd, dom, jnp.inf), axis=1)
+            min_c = jnp.where(jnp.isfinite(min_dom), min_dom, 0.0)
+            ok_cn = (
+                (cnt + 1.0 - min_c[:, None]) <= pod.spread_skew[:, None] + _EPS
+            ) & has_key_cn
+            spread_ok = jnp.all(
+                jnp.where(hard_c[:, None], ok_cn, True), axis=0
+            ) | ~fo[F_SPREAD]
+            score = jnp.where(spread_ok, score, -jnp.inf)
         node = jnp.argmax(score)
         ok = (score[node] > -jnp.inf) & active
         node_out = jnp.where(ok, node, -1)

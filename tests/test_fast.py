@@ -530,3 +530,44 @@ def test_micro_body_fires_for_soft_spread_groups():
     nodes_out = _assert_identical(ns, carry, batch)
     assert fast.PATH_COUNTS["micro"] > before["micro"]
     assert (nodes_out == -1).sum() > 0  # pods overflow the 9x10 slots
+
+
+def test_micro_body_handles_hard_spread():
+    """DoNotSchedule zone spread (non-hostname) is micro-eligible: domains
+    block and unblock as others fill, and the micro mask must replay the
+    oracle exactly including the overflow tail's reasons."""
+    from open_simulator_tpu.ops import fast
+
+    nodes = [
+        _node(
+            f"n-{i}", cpu="4" if i < 3 else "32", pods="12",
+            labels={"topology.kubernetes.io/zone": f"z-{i % 3}"},
+        )
+        for i in range(9)
+    ]
+    tmpl = _pod(
+        "t",
+        cpu="500m",
+        labels={"app": "hard"},
+        spec_extra={
+            "topologySpreadConstraints": [
+                {
+                    "maxSkew": 1,
+                    "topologyKey": "topology.kubernetes.io/zone",
+                    "whenUnsatisfiable": "DoNotSchedule",
+                    "labelSelector": {"matchLabels": {"app": "hard"}},
+                },
+                {
+                    "maxSkew": 4,
+                    "topologyKey": "topology.kubernetes.io/zone",
+                    "whenUnsatisfiable": "ScheduleAnyway",
+                    "labelSelector": {"matchLabels": {"app": "hard"}},
+                },
+            ]
+        },
+    )
+    ns, carry, batch = _encode(nodes, [tmpl], [120])
+    before = dict(fast.PATH_COUNTS)
+    nodes_out = _assert_identical(ns, carry, batch)
+    assert fast.PATH_COUNTS["micro"] > before["micro"]
+    assert (nodes_out == -1).sum() > 0
