@@ -554,7 +554,12 @@ def encode_pods(
     def cap(f, minimum=1):
         return max((f(pod) for pod in reps), default=minimum) or minimum
 
-    TERM = round_up(cap(lambda pd: len(pd.affinity.node_required)), 1)
+    # Feature-axis floors cover typical specs so batches from different apps
+    # (and capacity-search probes) share ONE jit shape family — distinct
+    # (TERM, EXPR, ...) combos each trace their own multi-second graphs
+    # otherwise. The axes are tiny relative to the [N]-wide work, so padding
+    # costs ~nothing; round_up still grows past the floor for outliers.
+    TERM = round_up(cap(lambda pd: len(pd.affinity.node_required)), 2)
     EXPR = round_up(
         cap(
             lambda pd: max(
@@ -566,7 +571,7 @@ def encode_pods(
                 + [0]
             )
         ),
-        2,
+        4,
     )
     VAL = round_up(
         cap(
@@ -584,12 +589,12 @@ def encode_pods(
                 + [0]
             )
         ),
-        2,
+        4,
     )
-    NS = round_up(cap(lambda pd: len(pd.node_selector)), 2)
-    PREF = round_up(cap(lambda pd: len(pd.affinity.node_preferred)), 1)
-    TOL = round_up(cap(lambda pd: len(pd.tolerations)), 2)
-    C = round_up(cap(lambda pd: len(pd.spread_constraints)), 1)
+    NS = round_up(cap(lambda pd: len(pd.node_selector)), 4)
+    PREF = round_up(cap(lambda pd: len(pd.affinity.node_preferred)), 2)
+    TOL = round_up(cap(lambda pd: len(pd.tolerations)), 4)
+    C = round_up(cap(lambda pd: len(pd.spread_constraints)), 2)
     A = round_up(
         cap(
             lambda pd: len(pd.affinity.pod_required)
@@ -597,7 +602,7 @@ def encode_pods(
             + len(pd.affinity.pod_preferred)
             + len(pd.affinity.anti_preferred)
         ),
-        1,
+        2,
     )
     vols = [pd.local_volumes() for pd in reps]
     SV = round_up(max((max(len(l), len(d)) for l, d in vols), default=1), 2)

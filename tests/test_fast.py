@@ -571,3 +571,38 @@ def test_micro_body_handles_hard_spread():
     nodes_out = _assert_identical(ns, carry, batch)
     assert fast.PATH_COUNTS["micro"] > before["micro"]
     assert (nodes_out == -1).sum() > 0
+
+
+def test_micro_body_hard_only_spread():
+    """ONLY DoNotSchedule constraints (no soft row): the micro body's spread
+    score must hit the raw=0 -> sp=100 constant branch exactly while the
+    hard mask still gates placements."""
+    from open_simulator_tpu.ops import fast
+
+    nodes = [
+        _node(
+            f"n-{i}", cpu="32", pods="10",
+            labels={"topology.kubernetes.io/zone": f"z-{i % 3}"},
+        )
+        for i in range(6)
+    ]
+    tmpl = _pod(
+        "t",
+        cpu="500m",
+        labels={"app": "hardonly"},
+        spec_extra={
+            "topologySpreadConstraints": [
+                {
+                    "maxSkew": 2,
+                    "topologyKey": "topology.kubernetes.io/zone",
+                    "whenUnsatisfiable": "DoNotSchedule",
+                    "labelSelector": {"matchLabels": {"app": "hardonly"}},
+                }
+            ]
+        },
+    )
+    ns, carry, batch = _encode(nodes, [tmpl], [70])
+    before = dict(fast.PATH_COUNTS)
+    nodes_out = _assert_identical(ns, carry, batch)
+    assert fast.PATH_COUNTS["micro"] > before["micro"]
+    assert (nodes_out == -1).sum() > 0  # 60 slots < 70 pods
