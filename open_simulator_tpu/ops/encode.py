@@ -536,7 +536,7 @@ def encode_pods(
     p = len(pods)
     P = p_pad if p_pad is not None else round_up(p)
     R = len(enc.resources)
-    S = max(len(enc.selectors), 1)
+    S = selector_table_size(enc)
 
     reps: List[Pod] = []
     rep_of: Dict[Tuple, int] = {}
@@ -607,7 +607,7 @@ def encode_pods(
     vols = [pd.local_volumes() for pd in reps]
     SV = round_up(max((max(len(l), len(d)) for l, d in vols), default=1), 2)
     HP = round_up(cap(lambda pd: len(pd.host_ports)), 2)
-    AT = max(len(enc.anti_terms), 1)
+    AT = anti_table_size(enc)
 
     b = PodBatch(
         req=np.zeros((D, R), np.float32),
@@ -817,6 +817,19 @@ def aggregate_usage(placed: Sequence[Tuple[Pod, str]]) -> Dict[str, Dict[str, in
     return usage
 
 
+def selector_table_size(enc: Encoder) -> int:
+    """Bucketed S axis (sel_counts rows / match_sel columns): registering one
+    more selector must not change every kernel's shape — pad rows hold zero
+    counts and False matches, which every consumer treats as inert."""
+    return round_up(max(len(enc.selectors), 1), 8)
+
+
+def anti_table_size(enc: Encoder) -> int:
+    """Bucketed AT axis (anti_counts rows / match_anti columns / anti_topo);
+    pad rows carry topo -1, which deactivates them in pod_affinity_mask."""
+    return round_up(max(len(enc.anti_terms), 1), 2)
+
+
 def port_table_sizes(enc: Encoder) -> Tuple[int, int]:
     """(PID, PIP) axis sizes for the port count tables. Row 0 is the pad row
     (vocab ids are 1-based), so sizes are len+1 rounded for bucket stability."""
@@ -859,7 +872,7 @@ def initial_anti_counts(
     """anti_counts f32[AT,N]: per (required-anti-affinity term, node) count of
     already-placed pods carrying the term. Bound pods' terms must have been
     registered (register_pods) before this is called."""
-    AT = max(len(enc.anti_terms), 1)
+    AT = anti_table_size(enc)
     counts = np.zeros((AT, table.n), np.float32)
     node_index = {name: i for i, name in enumerate(table.names)}
     for pod, node_name in placed:
@@ -877,7 +890,7 @@ def match_vector(enc: Encoder, pod: Pod) -> np.ndarray:
     clones, so a 100k-pod cluster hits the Python matcher only once per
     distinct workload instead of pods x selectors times (the reference's
     per-pod listers pay the full product; SURVEY §5.7 scale strategy)."""
-    S = max(len(enc.selectors), 1)
+    S = selector_table_size(enc)
     sig = (pod.meta.namespace, tuple(sorted(pod.meta.labels.items())))
     cached = enc._match_cache.get(sig)
     if cached is not None and cached.shape[0] == S:
@@ -897,7 +910,7 @@ def initial_selector_counts(
     """sel_counts f32[S,N]: per (selector, node) count of already-placed pods
     matching the selector. Seeded from existing cluster pods; maintained on
     device as the scan carry afterwards."""
-    S = max(len(enc.selectors), 1)
+    S = selector_table_size(enc)
     counts = np.zeros((S, table.n), np.float32)
     node_index = {name: i for i, name in enumerate(table.names)}
     for pod, node_name in placed:

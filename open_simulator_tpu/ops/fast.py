@@ -961,10 +961,14 @@ def schedule_batch_fast(
             if free_entry is not None and (force_fast or length >= 64)
             else None
         )
+        # Break-even: fast cost ≈ j_need heavy trajectory steps + length
+        # cheap selection steps (sort ≈ free, scan ≈ heavy/8), vs length
+        # heavy steps on the grouped path — fast wins from ~1.2x j_need;
+        # 1.5x keeps margin for the fixed exit/gather overhead.
         use_fast = (
             j_need is not None
             and _bucket_j(j_need) <= J_CAP
-            and (force_fast or length >= max(2 * j_need, 64))
+            and (force_fast or length >= max(3 * j_need // 2, 64))
         )
         if not use_fast:
             PATH_COUNTS["grouped"] += 1

@@ -14,7 +14,15 @@ from typing import Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from .encode import Encoder, NodeTable, PodBatch, port_table_sizes, round_up
+from .encode import (
+    Encoder,
+    NodeTable,
+    PodBatch,
+    anti_table_size,
+    port_table_sizes,
+    round_up,
+    selector_table_size,
+)
 from .kernels import Carry, NodeStatic, PodRow
 
 
@@ -62,7 +70,7 @@ def node_static_from_table(enc: Encoder, table: NodeTable) -> NodeStatic:
 
 def anti_topo_array(enc: Encoder) -> np.ndarray:
     """i32[AT] topo-key index per registered required-anti-affinity term."""
-    AT = max(len(enc.anti_terms), 1)
+    AT = anti_table_size(enc)
     arr = np.full(AT, -1, np.int32)
     for t, (k_idx, _sel) in enumerate(enc.anti_terms):
         arr[t] = k_idx
@@ -77,12 +85,17 @@ def carry_from_table(
     anti_counts: Optional[np.ndarray] = None,
 ) -> Carry:
     if sel_counts is None:
-        sel_counts = np.zeros((max(num_selectors, 1), table.n), np.float32)
+        # same bucketing as encode.selector_table_size so direct callers
+        # (bench, entry) line up with encode_pods' match_sel axis
+        sel_counts = np.zeros(
+            (round_up(max(num_selectors, 1), 8), table.n), np.float32
+        )
     if port_counts is None:
         z = np.zeros((2, table.n), np.float32)
         port_counts = (z, z, z)
     if anti_counts is None:
-        anti_counts = np.zeros((1, table.n), np.float32)
+        # encode.anti_table_size bucketing (min 2)
+        anti_counts = np.zeros((2, table.n), np.float32)
     return Carry(
         free=jnp.asarray(table.free),
         sel_counts=jnp.asarray(sel_counts),
@@ -162,11 +175,11 @@ def align_carry(
     pod_affinity_mask); returns (carry, ns) in that case."""
     PID, PIP = port_table_sizes(enc)
     new = {
-        "sel_counts": _grow_rows(carry.sel_counts, max(len(enc.selectors), 1)),
+        "sel_counts": _grow_rows(carry.sel_counts, selector_table_size(enc)),
         "port_any": _grow_rows(carry.port_any, PID),
         "port_wild": _grow_rows(carry.port_wild, PID),
         "port_ipc": _grow_rows(carry.port_ipc, PIP),
-        "anti_counts": _grow_rows(carry.anti_counts, max(len(enc.anti_terms), 1)),
+        "anti_counts": _grow_rows(carry.anti_counts, anti_table_size(enc)),
     }
     # preserve identity when nothing grew, so callers can use an `is` check
     # to decide whether sharded state needs re-pinning
