@@ -405,3 +405,27 @@ def test_existing_pods_consume_free():
     _, placed, reasons, *_ = schedule_batch(ns, carry, rows, weights_array())
     assert np.asarray(placed)[0] == -1  # only 1 cpu free, pod wants 2
     assert np.asarray(reasons)[0][F_RESOURCES] == 1
+
+
+def test_combine_scores_prefix_split_is_exact():
+    """The micro body's foundation: combine_scores' left fold must split
+    bitwise as fold(order[:-1]) + w_last * s_last (kernels.combine_scores
+    docstring; topology_spread is last by the SP_IDX assert in ops/fast.py)."""
+    import numpy as np
+
+    from open_simulator_tpu.ops.kernels import WEIGHT_ORDER, combine_scores
+
+    rng = np.random.default_rng(7)
+    N = 4097
+    by_name = {
+        k: (rng.standard_normal(N) * rng.integers(1, 1000)).astype(np.float32)
+        for k in WEIGHT_ORDER
+    }
+    w = rng.standard_normal(len(WEIGHT_ORDER)).astype(np.float32)
+
+    full = np.asarray(combine_scores(by_name, w))
+    prefix = np.asarray(combine_scores(by_name, w, order=WEIGHT_ORDER[:-1]))
+    split = prefix + w[-1] * by_name[WEIGHT_ORDER[-1]]
+    np.testing.assert_array_equal(
+        full.view(np.uint32), np.asarray(split).view(np.uint32)
+    )
