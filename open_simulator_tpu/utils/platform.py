@@ -25,6 +25,23 @@ def ensure_platform() -> None:
         jax.config.update("jax_platforms", plat)
 
 
+def _host_fingerprint() -> str:
+    """Short stable id for this host's CPU feature set."""
+    import hashlib
+    import platform as _plat
+
+    blob = _plat.machine()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    blob += line
+                    break
+    except OSError:
+        pass
+    return hashlib.blake2b(blob.encode(), digest_size=6).hexdigest()
+
+
 def enable_compilation_cache() -> None:
     """Persist XLA executables across processes (parity concern: the
     reference binary re-simulates a tweaked cluster interactively in seconds,
@@ -39,6 +56,12 @@ def enable_compilation_cache() -> None:
     if not path:
         return
     try:
+        # Key the cache by a host-CPU fingerprint: XLA:CPU AOT executables
+        # record the *compile* machine's feature set, and loading them on a
+        # host with fewer features risks SIGILL (observed when a cache
+        # written in an earlier round's container leaked into this one).
+        # Same machine => same key, so the cross-process win is kept.
+        path = os.path.join(path, _host_fingerprint())
         os.makedirs(path, exist_ok=True)
         import jax
 
