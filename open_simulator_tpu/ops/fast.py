@@ -79,7 +79,13 @@ J_CAP = 512
 # can't tell which path produced the result.
 PATH_COUNTS = {
     "sort": 0, "micro": 0, "scan": 0, "grouped": 0, "sort_fallback": 0,
+    "domain": 0, "domain_fallback": 0,
 }
+
+# Max combined (domain-tuple, eligibility) classes for the domain-merge path;
+# groups whose nodes span more classes take the micro scan instead. Tests may
+# set this to 0 to force the micro body.
+DM_CAP = 64
 
 
 # Channel layout of Trajectory.packed — everything the selection step needs,
@@ -660,36 +666,26 @@ def light_scan(
     return x_final, nodes, jidxs
 
 
-def _light_scan_micro(
-    ns, traj, carry0, pod, static_ok, static_scores, na_ok, weights,
-    x0, offset, group_size, valid_count, fo, flags,
-):
-    """The topology-spread micro body (see light_scan docstring). Traced inside
-    light_scan's jit; everything here but the scan body is loop-invariant."""
-    N = ns.valid.shape[0]
-    j_steps = traj.packed.shape[1]
-    D = ns.topo_onehot.shape[1]
+class SpreadTables(NamedTuple):
+    """Loop-invariant spread-reconstruction tables shared VERBATIM by the
+    micro body and the domain-merge path — one construction site keeps their
+    f32 arithmetic structurally bit-identical (the domain path's exactness
+    argument depends on it). in_key_cd is None unless flags.any_hard_spread."""
+    k_c: jnp.ndarray       # i32[C] topo key per constraint row
+    to_c: jnp.ndarray      # f32[C,D,N] domain membership per constraint
+    elig_f: jnp.ndarray    # f32[N] spread eligibility (na_ok & valid)
+    match_c: jnp.ndarray   # f32[C] pod matches the constraint's selector
+    base_dom: jnp.ndarray  # f32[C,D] eligible-node counts at group entry
+    active_c: jnp.ndarray  # bool[C] soft rows (feed the score)
+    hard_c: jnp.ndarray    # bool[C] DoNotSchedule rows (feed the mask)
+    in_key_cd: jnp.ndarray | None  # bool[C,D] eligible domains of the row's key
 
-    # partial9 per (node, lane): every score row except topology_spread,
-    # combined by the shared left fold — `p9 + w_sp * sp` then equals the
-    # full combine_scores result by construction (topology_spread is last).
-    p9 = combine_scores(
-        _lane_rows(ns, traj, pod, static_scores), weights,
-        order=WEIGHT_ORDER[:SP_IDX],
-    )                                                             # [N,J]
-    w_sp = weights[SP_IDX]
 
-    # feasibility per lane (micro: ports/resources are the only dynamics)
-    feas = (
-        static_ok[:, None]
-        & ((traj.packed[:, :, CH_PORT_OK] > 0.5) | ~fo[F_NODE_PORTS])
-        & ~((traj.packed[:, :, CH_RES_FAIL] > 0.5) & fo[F_RESOURCES])
-        & ns.valid[:, None]
-    )                                                             # [N,J]
-    score_lane = jnp.where(feas, p9, -jnp.inf)                    # [N,J]
-
-    # spread tables (non-hostname keys; soft rows feed the score, hard rows
-    # the mask — both share the per-row domain-count reconstruction)
+def _spread_tables(
+    ns: NodeStatic, carry0: Carry, pod: PodRow, na_ok, flags: GroupFlags
+) -> SpreadTables:
+    """Spread tables (non-hostname keys; soft rows feed the score, hard rows
+    the mask — both share the per-row domain-count reconstruction)."""
     active_c = (pod.spread_topo >= 0) & ~pod.spread_hard          # [C]
     hard_c = (pod.spread_topo >= 0) & pod.spread_hard             # [C]
     k_c = jnp.maximum(pod.spread_topo, 0)                         # [C]
@@ -701,12 +697,77 @@ def _light_scan_micro(
     base_dom = jnp.einsum(
         "cdn,cn->cd", to_c, counts0, precision=jax.lax.Precision.HIGHEST
     )                                                             # [C,D]
+    in_key_cd = None
     if flags.any_hard_spread:
-        has_key_cn = (ns.topo[:, k_c] >= 0).T                     # [C,N]
         dom_elig = jnp.einsum(
             "cdn,n->cd", to_c, elig_f, precision=jax.lax.Precision.HIGHEST
         ) > 0.0                                                   # [C,D]
         in_key_cd = (ns.domain_key[None, :] == k_c[:, None]) & dom_elig
+    return SpreadTables(
+        k_c, to_c, elig_f, match_c, base_dom, active_c, hard_c, in_key_cd
+    )
+
+
+def _lane_partials(ns, traj, pod, static_scores, static_ok, weights, fo):
+    """(p9, feas) per lane — partial9 is every score row except
+    topology_spread, combined by the shared left fold: `p9 + w_sp * sp` then
+    equals the full combine_scores result by construction (topology_spread
+    is last). Feasibility covers the only dynamics a micro-eligible group
+    has: ports and resources."""
+    p9 = combine_scores(
+        _lane_rows(ns, traj, pod, static_scores), weights,
+        order=WEIGHT_ORDER[:SP_IDX],
+    )                                                             # [N,J]
+    feas = (
+        static_ok[:, None]
+        & ((traj.packed[:, :, CH_PORT_OK] > 0.5) | ~fo[F_NODE_PORTS])
+        & ~((traj.packed[:, :, CH_RES_FAIL] > 0.5) & fo[F_RESOURCES])
+        & ns.valid[:, None]
+    )                                                             # [N,J]
+    return p9, feas
+
+
+def _spread_norm(raw: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """The topology-spread score normalization (mirror of
+    kernels.score_topology_spread on reconstructed counts); `valid` masks
+    which entries may define the max."""
+    mx = jnp.max(jnp.where(valid, raw, 0.0))
+    return jnp.where(mx > 0, (mx - raw) * 100.0 / jnp.maximum(mx, 1e-9), 100.0)
+
+
+def _hard_spread_ok(dom, cnt, st: SpreadTables, skew, has_key, f_spread_on):
+    """DoNotSchedule skew verdict (mirror kernels.spread_mask via the
+    reconstructed dom — integer-exact, so bit-identical). `cnt`/`has_key`
+    are per-(constraint, node) in the micro body and per-(constraint, class)
+    in the domain path; the arithmetic is identical."""
+    min_dom = jnp.min(jnp.where(st.in_key_cd, dom, jnp.inf), axis=1)
+    min_c = jnp.where(jnp.isfinite(min_dom), min_dom, 0.0)
+    ok = ((cnt + 1.0 - min_c[:, None]) <= skew[:, None] + _EPS) & has_key
+    return jnp.all(jnp.where(st.hard_c[:, None], ok, True), axis=0) | ~f_spread_on
+
+
+def _light_scan_micro(
+    ns, traj, carry0, pod, static_ok, static_scores, na_ok, weights,
+    x0, offset, group_size, valid_count, fo, flags,
+):
+    """The topology-spread micro body (see light_scan docstring). Traced inside
+    light_scan's jit; everything here but the scan body is loop-invariant."""
+    N = ns.valid.shape[0]
+    j_steps = traj.packed.shape[1]
+    D = ns.topo_onehot.shape[1]
+
+    p9, feas = _lane_partials(
+        ns, traj, pod, static_scores, static_ok, weights, fo
+    )
+    w_sp = weights[SP_IDX]
+    score_lane = jnp.where(feas, p9, -jnp.inf)                    # [N,J]
+
+    st = _spread_tables(ns, carry0, pod, na_ok, flags)
+    active_c, hard_c = st.active_c, st.hard_c
+    k_c, to_c, elig_f = st.k_c, st.to_c, st.elig_f
+    match_c, base_dom = st.match_c, st.base_dom
+    if flags.any_hard_spread:
+        has_key_cn = (ns.topo[:, k_c] >= 0).T                     # [C,N]
     xf0 = x0.astype(jnp.float32)
     y0 = jnp.einsum(
         "cdn,n->cd", to_c, elig_f * xf0,
@@ -727,22 +788,12 @@ def _light_scan_micro(
             "cd,cdn->cn", dom, to_c, precision=jax.lax.Precision.HIGHEST
         )                                                         # [C,N]
         raw = jnp.sum(jnp.where(active_c[:, None], cnt, 0.0), axis=0)
-        mx = jnp.max(jnp.where(ns.valid, raw, 0.0))
-        sp = jnp.where(
-            mx > 0, (mx - raw) * 100.0 / jnp.maximum(mx, 1e-9), 100.0
-        )
+        sp = _spread_norm(raw, ns.valid)
         score = cur_s + w_sp * sp                                 # -inf stays
         if flags.any_hard_spread:
-            # DoNotSchedule skew check (mirror kernels.spread_mask via the
-            # reconstructed dom — integer-exact, so bit-identical)
-            min_dom = jnp.min(jnp.where(in_key_cd, dom, jnp.inf), axis=1)
-            min_c = jnp.where(jnp.isfinite(min_dom), min_dom, 0.0)
-            ok_cn = (
-                (cnt + 1.0 - min_c[:, None]) <= pod.spread_skew[:, None] + _EPS
-            ) & has_key_cn
-            spread_ok = jnp.all(
-                jnp.where(hard_c[:, None], ok_cn, True), axis=0
-            ) | ~fo[F_SPREAD]
+            spread_ok = _hard_spread_ok(
+                dom, cnt, st, pod.spread_skew, has_key_cn, fo[F_SPREAD]
+            )
             score = jnp.where(spread_ok, score, -jnp.inf)
         node = jnp.argmax(score)
         ok = (score[node] > -jnp.inf) & active
@@ -769,6 +820,195 @@ def _light_scan_micro(
         step, (x0, cur_s0, y0), jnp.arange(group_size)
     )
     return x_final, nodes, jidxs
+
+
+class DomainPlan(NamedTuple):
+    """Host-built static structure for the domain-merge path: the partition
+    of nodes into combined (spread-domain-tuple, eligibility) classes. All
+    nodes in one class are interchangeable w.r.t. every carry-coupled term of
+    a micro-eligible group (topology spread is domain-keyed, and these nodes
+    share every constraint's domain), so the scan state shrinks from [N] to
+    [Dc] — see domain_select.
+
+    Dc is PADDED to max(4, next_pow2(real classes)) for jit-shape reuse; the
+    synthetic tail classes hold counts=0 / elig=0 / combo_valid=False, so
+    they are permanently exhausted and excluded from the spread max. Callers
+    wanting the real class count must use combo_of_node.max()+1, not
+    counts.shape[0]."""
+    combo_of_node: np.ndarray  # i32[N] class id per node
+    counts: np.ndarray         # i32[Dc] trajectory lanes per class (nodes * J)
+    offsets: np.ndarray        # i32[Dc] class start in the combo-sorted order
+    elig_combo: np.ndarray     # f32[Dc] 1.0 = class counts for spread
+    combo_valid: np.ndarray    # bool[Dc] class holds >= 1 valid node
+    t_onehot: np.ndarray       # f32[C,D,Dc] domain membership per constraint
+    has_key: np.ndarray        # bool[C,Dc] class has constraint c's topo key
+
+
+def _domain_plan(
+    spread_topo_np: np.ndarray,
+    topo_np: np.ndarray,
+    valid_np: np.ndarray,
+    elig_np: np.ndarray,
+    j_steps: int,
+    n_domains: int,
+):
+    """Partition nodes into combined domain classes; None when the group is
+    too fragmented (Dc > DM_CAP) to beat the micro scan."""
+    act = spread_topo_np >= 0
+    cols = topo_np[:, spread_topo_np[act]]                      # [N,A]
+    keymat = np.concatenate([cols, elig_np[:, None].astype(np.int32)], axis=1)
+    uniq, inv = np.unique(keymat, axis=0, return_inverse=True)
+    dc = uniq.shape[0]
+    if dc > DM_CAP:
+        return None
+    dc_pad = max(4, 1 << (dc - 1).bit_length())
+    node_counts = np.bincount(inv, minlength=dc_pad)
+    counts = (node_counts * j_steps).astype(np.int32)
+    offsets = np.concatenate([[0], np.cumsum(counts)[:-1]]).astype(np.int32)
+    elig_combo = np.zeros(dc_pad, np.float32)
+    elig_combo[:dc] = uniq[:, -1]
+    combo_valid = np.zeros(dc_pad, bool)
+    np.logical_or.at(combo_valid, inv, valid_np)
+    C = spread_topo_np.shape[0]
+    map_cd = np.full((C, dc_pad), -1, np.int32)
+    map_cd[np.nonzero(act)[0][:, None], np.arange(dc)[None, :]] = uniq[:, :-1].T
+    # -1 (inactive constraint / missing key) matches no domain id, so those
+    # columns are all-zero without an explicit mask.
+    t_onehot = (
+        map_cd[:, None, :] == np.arange(n_domains)[None, :, None]
+    ).astype(np.float32)
+    return DomainPlan(
+        inv.astype(np.int32), counts, offsets, elig_combo, combo_valid,
+        t_onehot, map_cd >= 0,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("group_size", "l_cap", "flags"))
+def domain_select(
+    ns: NodeStatic,
+    traj: Trajectory,
+    carry0: Carry,
+    pod: PodRow,
+    static_ok: jnp.ndarray,
+    static_scores: dict,
+    na_ok: jnp.ndarray,
+    weights: jnp.ndarray,
+    combo_of_node: jnp.ndarray,
+    counts: jnp.ndarray,
+    offsets: jnp.ndarray,
+    elig_combo: jnp.ndarray,
+    combo_valid: jnp.ndarray,
+    t_onehot: jnp.ndarray,
+    has_key_cm: jnp.ndarray,
+    group_size: int,
+    l_cap: int,
+    valid_count: jnp.ndarray,
+    filter_on=None,
+    flags: GroupFlags = ALL_DYNAMIC,
+):
+    """Whole-group selection with an O(Dc) scan state for micro-eligible
+    groups (topology spread the only carry-coupled term, non-hostname keys).
+
+    Two structural facts shrink the scan from O(N) to O(Dc) per step:
+      1. The spread term is DOMAIN-keyed: every node of a combined class
+         (same domain under each constraint, same eligibility) shares the
+         same spread score and DoNotSchedule verdict at every step.
+      2. Within a class, relative order is by the node-local partial score
+         alone (the spread addend is class-constant), so the scan's pick
+         sequence inside a class is the sort-path merge: one stable sort of
+         all [N,J] lanes keyed (class, score desc) — ties resolve to the
+         lowest flat index = the scan's first-max argmax.
+
+    The scan then walks per-class HEAD pointers: each step scores only the
+    Dc class heads (head partial + w_sp * spread(class)), pops the winner,
+    and updates the [Dc] domain-count state. Cross-class ties pick the
+    lowest head node index, which equals the global argmax tie-break because
+    each class head is its class's lowest-index maximum.
+
+    Exactness: head partials are the same f32 lane values, domain counts are
+    reconstructed with the micro body's own einsum arithmetic (exact integer
+    f32), and the spread normalization applies the identical expression —
+    so every per-step total is bit-identical to the micro scan's winning
+    score. mono_ok False (a lane sequence rose) voids fact 2; the caller
+    falls back to the micro scan, like the sort path.
+
+    Returns (mono_ok, nodes i32[group_size], jidx i32[group_size], x i32[N]).
+    """
+    N, J, _ = traj.packed.shape
+    Dc = counts.shape[0]
+    fo = jnp.ones(NUM_FILTERS, bool) if filter_on is None else filter_on
+
+    p9, feas = _lane_partials(
+        ns, traj, pod, static_scores, static_ok, weights, fo
+    )
+    score_lane = jnp.where(feas, p9, -jnp.inf)
+    mono_ok = jnp.all(score_lane[:, 1:] <= score_lane[:, :-1])
+
+    # Stable sort keyed (class asc, score desc): within a class, lanes land
+    # in exactly the order the scan would pop them (ties keep flat order =
+    # lowest node first, then increasing j within a node).
+    flat_combo = jnp.broadcast_to(combo_of_node[:, None], (N, J)).reshape(-1)
+    neg = (-score_lane).reshape(-1)
+    flat_idx = jnp.arange(N * J, dtype=jnp.int32)
+    _, sneg, sidx = jax.lax.sort(
+        (flat_combo, neg, flat_idx), num_keys=2, is_stable=True
+    )
+    gidx = jnp.clip(offsets[:, None] + jnp.arange(l_cap)[None, :], 0, N * J - 1)
+    in_range = jnp.arange(l_cap)[None, :] < counts[:, None]
+    hscore = jnp.where(in_range, -sneg[gidx], -jnp.inf)           # [Dc,L]
+    hflat = sidx[gidx]
+    hnode = (hflat // J).astype(jnp.int32)
+    hj = (hflat % J).astype(jnp.int32)
+    cap_eff = jnp.minimum(counts, l_cap)
+
+    # spread tables — the micro body's own construction (shared helper, so
+    # the arithmetic cannot drift between the two bodies)
+    st = _spread_tables(ns, carry0, pod, na_ok, flags)
+    w_sp = weights[SP_IDX]
+
+    def step(carry_hy, i):
+        h, y = carry_hy
+        dom = st.base_dom + st.match_c[:, None] * jnp.einsum(
+            "cdm,m->cd", t_onehot, y, precision=jax.lax.Precision.HIGHEST
+        )                                                         # [C,D]
+        cnt_cm = jnp.einsum(
+            "cd,cdm->cm", dom, t_onehot, precision=jax.lax.Precision.HIGHEST
+        )                                                         # [C,Dc]
+        raw = jnp.sum(jnp.where(st.active_c[:, None], cnt_cm, 0.0), axis=0)
+        sp = _spread_norm(raw, combo_valid)                       # [Dc]
+        hc = jnp.clip(h, 0, l_cap - 1)[:, None]
+        hs = jnp.where(
+            h < cap_eff,
+            jnp.take_along_axis(hscore, hc, axis=1)[:, 0],
+            -jnp.inf,
+        )
+        total = hs + w_sp * sp
+        if flags.any_hard_spread:
+            spread_ok = _hard_spread_ok(
+                dom, cnt_cm, st, pod.spread_skew, has_key_cm, fo[F_SPREAD]
+            )
+            total = jnp.where(spread_ok, total, -jnp.inf)
+        node_h = jnp.take_along_axis(hnode, hc, axis=1)[:, 0]
+        j_h = jnp.take_along_axis(hj, hc, axis=1)[:, 0]
+        mx_t = jnp.max(total)
+        m = jnp.argmin(jnp.where(total == mx_t, node_h, N))
+        ok = (mx_t > -jnp.inf) & (i < valid_count)
+        node_out = jnp.where(ok, node_h[m], -1)
+        j_out = jnp.where(ok, j_h[m], 0)
+        oh = (jnp.arange(Dc) == m) & ok
+        return (
+            h + oh.astype(jnp.int32),
+            y + oh.astype(jnp.float32) * elig_combo,
+        ), (node_out.astype(jnp.int32), j_out.astype(jnp.int32))
+
+    _, (nodes, jidxs) = jax.lax.scan(
+        step,
+        (jnp.zeros(Dc, jnp.int32), jnp.zeros(Dc, jnp.float32)),
+        jnp.arange(group_size),
+    )
+    sel_n = jnp.clip(nodes, 0, N - 1)
+    x = jnp.zeros(N, jnp.int32).at[sel_n].add((nodes >= 0).astype(jnp.int32))
+    return mono_ok, nodes, jidxs, x
 
 
 @functools.partial(jax.jit, static_argnames=("flags",))
@@ -940,6 +1180,8 @@ def schedule_batch_fast(
     # for every later group.
     free_entry = np.asarray(carry.free) if res_filter_on else None
     anti_topo_np = np.asarray(ns.anti_topo)
+    topo_np = np.asarray(ns.topo)
+    n_domains = int(ns.topo_onehot.shape[1])
 
     for start, length in group_runs(batch):
         row = jax.tree.map(lambda a: a[start], rows_all)
@@ -1012,8 +1254,41 @@ def schedule_batch_fast(
                 # argument doesn't hold, replay with the scan below
                 PATH_COUNTS["sort_fallback"] += 1
 
+        domain_done = False
+        if not sorted_ok and flags.micro_spread:
+            # Domain-merge path: O(Dc) scan state instead of O(N). The class
+            # partition needs the pod's spread eligibility on host (one small
+            # bool[N] transfer per group).
+            elig_np = np.asarray(na_ok) & valid_np
+            plan = _domain_plan(
+                batch.spread_topo[start], topo_np, valid_np, elig_np,
+                j_steps, n_domains,
+            )
+            if plan is not None:
+                g = _bucket_light(length)
+                l_cap = _bucket_light(min(int(plan.counts.max()), length))
+                mono, nodes_w, jidx_w, x_w = domain_select(
+                    ns, traj, carry, row, static_ok, static_scores, na_ok,
+                    weights, plan.combo_of_node, plan.counts, plan.offsets,
+                    plan.elig_combo, plan.combo_valid, plan.t_onehot,
+                    plan.has_key, g, l_cap, jnp.int32(length), filter_on,
+                    flags,
+                )
+                if bool(mono):
+                    PATH_COUNTS["domain"] += 1
+                    nodes_d = nodes_w[:length]
+                    jidx_d = jidx_w[:length]
+                    x = x_w
+                    domain_done = True
+                else:
+                    # a rising lane sequence voids the within-class merge
+                    # argument — replay with the micro scan
+                    PATH_COUNTS["domain_fallback"] += 1
+
         if sorted_ok:
             PATH_COUNTS["sort"] += 1
+        elif domain_done:
+            pass
         else:
             PATH_COUNTS["micro" if flags.micro_spread else "scan"] += 1
             x = jnp.zeros(N, jnp.int32)

@@ -497,12 +497,40 @@ def test_sort_path_monotonicity_fallback_is_exact():
     )
 
 
-def test_micro_body_fires_for_soft_spread_groups():
-    """Soft non-hostname spread with no other coupling must take the micro
-    body (partial9 + w*spread), staying exact through domain block/unblock
-    and the overflow tail."""
+@pytest.fixture(params=["domain", "micro"])
+def spread_path(request):
+    """Run a spread scenario through both strategies: the domain-merge path
+    (default) and the micro scan (forced by DM_CAP=0). Yields the expected
+    PATH_COUNTS key; both must be bit-identical to the oracle."""
     from open_simulator_tpu.ops import fast
 
+    old = fast.DM_CAP
+    if request.param == "micro":
+        fast.DM_CAP = 0
+    try:
+        yield request.param
+    finally:
+        fast.DM_CAP = old
+
+
+def _assert_spread_path(nodes, tmpl, count, path, min_unscheduled=1):
+    from open_simulator_tpu.ops import fast
+
+    ns, carry, batch = _encode(nodes, [tmpl], [count])
+    before = dict(fast.PATH_COUNTS)
+    nodes_out = _assert_identical(ns, carry, batch)
+    assert fast.PATH_COUNTS[path] > before[path], (
+        f"expected the {path} path; deltas "
+        f"{ {k: fast.PATH_COUNTS[k] - before[k] for k in before} }"
+    )
+    total = int(batch.valid.sum())
+    assert (nodes_out[:total] == -1).sum() >= min_unscheduled
+    return nodes_out
+
+
+def test_spread_soft_groups(spread_path):
+    """Soft non-hostname spread with no other coupling: exact through domain
+    block/unblock and the overflow tail, on both spread strategies."""
     nodes = [
         _node(
             f"n-{i}", cpu="8", pods="10",
@@ -525,19 +553,13 @@ def test_micro_body_fires_for_soft_spread_groups():
             ]
         },
     )
-    ns, carry, batch = _encode(nodes, [tmpl], [100])
-    before = dict(fast.PATH_COUNTS)
-    nodes_out = _assert_identical(ns, carry, batch)
-    assert fast.PATH_COUNTS["micro"] > before["micro"]
-    assert (nodes_out == -1).sum() > 0  # pods overflow the 9x10 slots
+    _assert_spread_path(nodes, tmpl, 100, spread_path)
 
 
-def test_micro_body_handles_hard_spread():
-    """DoNotSchedule zone spread (non-hostname) is micro-eligible: domains
-    block and unblock as others fill, and the micro mask must replay the
-    oracle exactly including the overflow tail's reasons."""
-    from open_simulator_tpu.ops import fast
-
+def test_spread_hard_plus_soft(spread_path):
+    """DoNotSchedule zone spread stacked with a soft row: domains block and
+    unblock as others fill; the masks must replay the oracle exactly
+    including the overflow tail's reasons."""
     nodes = [
         _node(
             f"n-{i}", cpu="4" if i < 3 else "32", pods="12",
@@ -566,19 +588,13 @@ def test_micro_body_handles_hard_spread():
             ]
         },
     )
-    ns, carry, batch = _encode(nodes, [tmpl], [120])
-    before = dict(fast.PATH_COUNTS)
-    nodes_out = _assert_identical(ns, carry, batch)
-    assert fast.PATH_COUNTS["micro"] > before["micro"]
-    assert (nodes_out == -1).sum() > 0
+    _assert_spread_path(nodes, tmpl, 120, spread_path)
 
 
-def test_micro_body_hard_only_spread():
-    """ONLY DoNotSchedule constraints (no soft row): the micro body's spread
-    score must hit the raw=0 -> sp=100 constant branch exactly while the
-    hard mask still gates placements."""
-    from open_simulator_tpu.ops import fast
-
+def test_spread_hard_only(spread_path):
+    """ONLY DoNotSchedule constraints (no soft row): the spread score must
+    hit the raw=0 -> sp=100 constant branch exactly while the hard mask
+    still gates placements."""
     nodes = [
         _node(
             f"n-{i}", cpu="32", pods="10",
@@ -601,8 +617,144 @@ def test_micro_body_hard_only_spread():
             ]
         },
     )
-    ns, carry, batch = _encode(nodes, [tmpl], [70])
-    before = dict(fast.PATH_COUNTS)
-    nodes_out = _assert_identical(ns, carry, batch)
-    assert fast.PATH_COUNTS["micro"] > before["micro"]
-    assert (nodes_out == -1).sum() > 0  # 60 slots < 70 pods
+    _assert_spread_path(nodes, tmpl, 70, spread_path)
+
+
+def test_spread_two_keys(spread_path):
+    """Two constraints on DIFFERENT topology keys: the domain path's
+    combined classes are (zone, rack) tuples; counts under each constraint
+    aggregate across classes sharing that key's domain."""
+    nodes = [
+        _node(
+            f"n-{i}", cpu="2", pods="14",
+            labels={
+                "topology.kubernetes.io/zone": f"z-{i % 2}",
+                "rack": f"r-{i % 4}",
+            },
+        )
+        for i in range(12)
+    ]
+    tmpl = _pod(
+        "t",
+        cpu="500m",
+        labels={"app": "mk"},
+        spec_extra={
+            "topologySpreadConstraints": [
+                {
+                    "maxSkew": 3,
+                    "topologyKey": "topology.kubernetes.io/zone",
+                    "whenUnsatisfiable": "ScheduleAnyway",
+                    "labelSelector": {"matchLabels": {"app": "mk"}},
+                },
+                {
+                    "maxSkew": 2,
+                    "topologyKey": "rack",
+                    "whenUnsatisfiable": "DoNotSchedule",
+                    "labelSelector": {"matchLabels": {"app": "mk"}},
+                },
+            ]
+        },
+    )
+    _assert_spread_path(nodes, tmpl, 60, spread_path)
+
+
+def test_spread_eligibility_split(spread_path):
+    """A nodeSelector restricts spread eligibility to a node subset: classes
+    split on the eligibility bit, ineligible nodes never count toward
+    domains, and DoNotSchedule minimums consider eligible domains only."""
+    nodes = [
+        _node(
+            f"n-{i}", cpu="8", pods="14",
+            labels={
+                "topology.kubernetes.io/zone": f"z-{i % 3}",
+                "tier": "gold" if i % 2 == 0 else "silver",
+            },
+        )
+        for i in range(10)
+    ]
+    tmpl = _pod(
+        "t",
+        cpu="500m",
+        labels={"app": "el"},
+        spec_extra={
+            "nodeSelector": {"tier": "gold"},
+            "topologySpreadConstraints": [
+                {
+                    "maxSkew": 1,
+                    "topologyKey": "topology.kubernetes.io/zone",
+                    "whenUnsatisfiable": "DoNotSchedule",
+                    "labelSelector": {"matchLabels": {"app": "el"}},
+                }
+            ]
+        },
+    )
+    _assert_spread_path(nodes, tmpl, 60, spread_path)
+
+
+def test_spread_missing_key_nodes(spread_path):
+    """Nodes without the topology key: soft counts treat them as count-0,
+    the hard constraint excludes them entirely."""
+    nodes = [
+        _node(
+            f"n-{i}", cpu="32", pods="10",
+            labels=(
+                {"topology.kubernetes.io/zone": f"z-{i % 3}"} if i < 6 else {}
+            ),
+        )
+        for i in range(9)
+    ]
+    tmpl = _pod(
+        "t",
+        cpu="500m",
+        labels={"app": "hardonly"},
+        spec_extra={
+            "topologySpreadConstraints": [
+                {
+                    "maxSkew": 2,
+                    "topologyKey": "topology.kubernetes.io/zone",
+                    "whenUnsatisfiable": "DoNotSchedule",
+                    "labelSelector": {"matchLabels": {"app": "hardonly"}},
+                }
+            ]
+        },
+    )
+    _assert_spread_path(nodes, tmpl, 80, spread_path)
+
+
+def test_domain_cap_falls_back_to_micro():
+    """A group spanning more combined classes than DM_CAP must take the
+    micro scan (the [Dc] state would not beat it), still exact."""
+    from open_simulator_tpu.ops import fast
+
+    nodes = [
+        _node(
+            f"n-{i}", cpu="8", pods="10",
+            labels={"topology.kubernetes.io/zone": f"z-{i}"},  # 8 distinct
+        )
+        for i in range(8)
+    ]
+    tmpl = _pod(
+        "t",
+        cpu="500m",
+        labels={"app": "many"},
+        spec_extra={
+            "topologySpreadConstraints": [
+                {
+                    "maxSkew": 5,
+                    "topologyKey": "topology.kubernetes.io/zone",
+                    "whenUnsatisfiable": "ScheduleAnyway",
+                    "labelSelector": {"matchLabels": {"app": "many"}},
+                }
+            ]
+        },
+    )
+    old = fast.DM_CAP
+    fast.DM_CAP = 4
+    try:
+        ns, carry, batch = _encode(nodes, [tmpl], [90])
+        before = dict(fast.PATH_COUNTS)
+        _assert_identical(ns, carry, batch)
+        assert fast.PATH_COUNTS["micro"] > before["micro"]
+        assert fast.PATH_COUNTS["domain"] == before["domain"]
+    finally:
+        fast.DM_CAP = old
