@@ -95,6 +95,7 @@ def _probe(
     mesh=None,
     n_pad: Optional[int] = None,
     profiles=None,
+    expand_cache: Optional[dict] = None,
 ) -> SimulateResult:
     trial = ClusterResource(
         nodes=list(cluster.nodes) + new_fake_nodes(template, k),
@@ -104,7 +105,7 @@ def _probe(
     )
     return simulate(
         trial, apps, weights=weights, use_greed=use_greed, mesh=mesh,
-        n_pad=n_pad, profiles=profiles,
+        n_pad=n_pad, profiles=profiles, expand_cache=expand_cache,
     )
 
 
@@ -146,12 +147,16 @@ def plan_capacity(
 
     attempts = 0
     n_base = len(cluster.nodes)
+    # Workload expansion/validation is node-independent for everything but
+    # DaemonSets — one shared cache expands the 100k-pod workload once for
+    # the whole search instead of once per probe.
+    expand_cache: dict = {}
 
     def good(res: SimulateResult) -> bool:
         return not res.unscheduled and satisfy_resource_setting(res)
 
     base = _probe(cluster, apps, new_node, 0, weights, use_greed, mesh,
-                  profiles=profiles)
+                  profiles=profiles, expand_cache=expand_cache)
     attempts += 1
     if good(base):
         return CapacityPlan(0, base, attempts)
@@ -171,7 +176,7 @@ def plan_capacity(
         # mid-probe shares the bracket's bucket)
         hi_result = _probe(
             cluster, apps, new_node, hi, weights, use_greed, mesh,
-            profiles=profiles,
+            profiles=profiles, expand_cache=expand_cache,
         )
         attempts += 1
         if good(hi_result):
@@ -181,16 +186,28 @@ def plan_capacity(
     else:
         return None
     best, best_result = hi, hi_result
+    last_result = hi_result
     n_pad = round_up(n_base + hi, 64)
     while lo + 1 < hi:
         mid = (lo + hi) // 2
         res = _probe(
             cluster, apps, new_node, mid, weights, use_greed, mesh,
-            n_pad=n_pad, profiles=profiles,
+            n_pad=n_pad, profiles=profiles, expand_cache=expand_cache,
         )
         attempts += 1
+        last_result = res
         if good(res):
             hi, best, best_result = mid, mid, res
         else:
             lo = mid
+    if last_result is not best_result:
+        # Probes share cached pod objects, and every probe rebinds them — so
+        # an earlier probe's result no longer reflects its own placements.
+        # Replay the winning count once so the returned result's pods carry
+        # their true bindings (same executables, so this is one cheap run).
+        best_result = _probe(
+            cluster, apps, new_node, best, weights, use_greed, mesh,
+            n_pad=n_pad, profiles=profiles, expand_cache=expand_cache,
+        )
+        attempts += 1
     return CapacityPlan(best, best_result, attempts)

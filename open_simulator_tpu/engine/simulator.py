@@ -166,13 +166,21 @@ class Simulator:
         profiles=None,
         plugins=None,
         patch_pods=None,
+        expand_cache=None,
     ) -> None:
         """`mesh` (jax.sharding.Mesh or None): when set, the node axis of the
         cluster state is sharded across the mesh devices and the same grouped
         scheduling program runs under GSPMD — per-node filter/score work on
         local shards, argmax/min-max/domain reductions as ICI collectives
         (the production analog of the reference's 16-goroutine node fan-out,
-        parallelize/parallelism.go:26-57)."""
+        parallelize/parallelism.go:26-57).
+
+        `expand_cache` (dict or None): capacity-search optimization — a dict
+        shared across repeated simulations of the SAME apps against varying
+        node sets (engine/capacity.plan_capacity). Non-DaemonSet workload
+        pods are expanded, patched and validated once, then rebound fresh on
+        every reuse; DaemonSet pods stay per-run (their synthesis is
+        per-node). Do not share a cache between different app lists."""
         self.cluster = cluster
         self.use_greed = use_greed
         self.mesh = mesh
@@ -189,6 +197,7 @@ class Simulator:
         # simulator.go:243-249,471-500): kind -> fn(List[Pod]) applied to
         # every pod list generated from that workload kind.
         self._patch_pods = dict(patch_pods or {})
+        self._expand_cache = expand_cache
         # Apiserver-grade validation before anything schedules: the reference
         # validates every imported node and synthesized pod and fails the
         # whole Simulate on the first invalid object (utils.go:495-508).
@@ -556,10 +565,7 @@ class Simulator:
                 list(self._bound),
                 dict(self._storage_takes),
                 len(self._preempted),
-                [
-                    (v, v.node_name, v.phase, v.meta.annotations.get(ANNO_GPU_INDEX))
-                    for v in res.victims
-                ],
+                self._snapshot_bindings(res.victims),
             )
             self._evict(res.victims, res.node, by=pod.key)
             # Reschedule the preemptor now that room exists. The reference
@@ -572,10 +578,7 @@ class Simulator:
                 self._bound = bound_list
                 self._storage_takes = takes
                 del self._preempted[n_pre:]
-                for v, node_name, phase, gpu_anno in fields:
-                    v.node_name, v.phase = node_name, phase
-                    if gpu_anno is not None:
-                        v.meta.annotations[ANNO_GPU_INDEX] = gpu_anno
+                self._restore_bindings(fields)
                 still_failed.extend(retry_failed)
             else:
                 bound_by_node = None  # placements changed; rebuild lazily
@@ -629,9 +632,7 @@ class Simulator:
             for aid in self.enc.anti_ids(v):
                 if aid < anti.shape[0]:
                     anti[aid, ni] -= 1.0
-            v.node_name = ""
-            v.phase = "Pending"
-            v.meta.annotations.pop(ANNO_GPU_INDEX, None)
+            self._reset_bindings([v])
             self._preempted.append(PreemptedPod(pod=v, node=node_name, by=by))
         self._carry = self._carry._replace(
             free=free, sel_counts=sel, gpu_free=gpu, vg_free=vg, dev_free=dev,
@@ -639,6 +640,36 @@ class Simulator:
             anti_counts=anti,
         )
         self._reshard()
+
+    # The engine's FULL mutation surface on a pod is node_name / phase / the
+    # gpu-index annotation (placement at _schedule_run, eviction at _evict) —
+    # everything else is tracked outside the object. The three helpers below
+    # are the only places that field set appears; extend all of them together.
+
+    @staticmethod
+    def _reset_bindings(pods: List[Pod]) -> None:
+        """Return pods to their pre-scheduling state (expand-cache reuse and
+        preemption eviction)."""
+        for p in pods:
+            p.node_name = ""
+            p.phase = "Pending"
+            p.meta.annotations.pop(ANNO_GPU_INDEX, None)
+
+    @staticmethod
+    def _snapshot_bindings(pods: List[Pod]) -> list:
+        return [
+            (p, p.node_name, p.phase, p.meta.annotations.get(ANNO_GPU_INDEX))
+            for p in pods
+        ]
+
+    @staticmethod
+    def _restore_bindings(fields: list) -> None:
+        for p, node_name, phase, gpu_anno in fields:
+            p.node_name, p.phase = node_name, phase
+            if gpu_anno is not None:
+                p.meta.annotations[ANNO_GPU_INDEX] = gpu_anno
+            else:
+                p.meta.annotations.pop(ANNO_GPU_INDEX, None)
 
     def _apply_patch_hooks(self, kind: str, pods: List[Pod]) -> None:
         """WithPatchPodsFuncMap parity (simulator.go:243-249,471-500): let the
@@ -660,15 +691,39 @@ class Simulator:
             with span("expand-workloads"):
                 for app in apps:
                     pods: List[Pod] = []
-                    for obj in app.objects:
+                    # keyed by POSITION in the app list, not name — the Simon
+                    # CR does not forbid duplicate app names, and the cache
+                    # contract already fixes the app list across reuses
+                    cache_key = len(app_pods)
+                    cached = (
+                        self._expand_cache.get(cache_key)
+                        if self._expand_cache is not None
+                        else None
+                    )
+                    fresh_entry: Dict[int, List[Pod]] = {}
+                    fresh_validate: List[Pod] = []
+                    for idx, obj in enumerate(app.objects):
                         kind = obj.get("kind", "")
-                        if kind in WORKLOAD_KINDS:
+                        if kind not in WORKLOAD_KINDS:
+                            continue
+                        if kind != "DaemonSet" and cached is not None:
+                            wl_pods = cached[idx]
+                            self._reset_bindings(wl_pods)
+                        else:
                             wl_pods = pods_from_workload(
                                 obj, nodes=self.cluster.nodes
                             )
                             self._apply_patch_hooks(kind, wl_pods)
-                            pods.extend(wl_pods)
-                    check_pods(pods, where=f"app {app.name}")
+                            fresh_validate.extend(wl_pods)
+                            if kind != "DaemonSet":
+                                fresh_entry[idx] = wl_pods
+                        pods.extend(wl_pods)
+                    # Cached pods were validated when first expanded; only
+                    # newly generated ones (first run, or DaemonSet pods,
+                    # whose synthesis is per-node) need checking.
+                    check_pods(fresh_validate, where=f"app {app.name}")
+                    if self._expand_cache is not None and cached is None:
+                        self._expand_cache[cache_key] = fresh_entry
                     app_pods.append(self._order(pods))
 
             with span("encode-cluster"):
@@ -752,13 +807,17 @@ def simulate(
     profiles=None,
     plugins=None,
     patch_pods=None,
+    expand_cache=None,
 ) -> SimulateResult:
     """One-shot simulation (parity: simulator.Simulate, core.go:67-119).
 
     `plugins`: out-of-tree DevicePlugin registry (plugins/__init__.py).
     `patch_pods`: {workload kind: fn(List[Pod])} mutation hooks applied to
-    generated pods (WithPatchPodsFuncMap parity)."""
+    generated pods (WithPatchPodsFuncMap parity).
+    `expand_cache`: see Simulator — share one dict across re-simulations of
+    the same apps (capacity search) to expand/validate workloads once."""
     return Simulator(
         cluster, weights=weights, use_greed=use_greed, mesh=mesh, n_pad=n_pad,
         profiles=profiles, plugins=plugins, patch_pods=patch_pods,
+        expand_cache=expand_cache,
     ).run(apps)

@@ -1001,10 +1001,14 @@ def domain_select(
             y + oh.astype(jnp.float32) * elig_combo,
         ), (node_out.astype(jnp.int32), j_out.astype(jnp.int32))
 
+    # The step body is tiny ([Dc]-sized ops), so per-iteration dispatch
+    # overhead dominates — unrolling amortizes it without changing the op
+    # sequence (group_size is a multiple of 16: _bucket_light floors at 32).
     _, (nodes, jidxs) = jax.lax.scan(
         step,
         (jnp.zeros(Dc, jnp.int32), jnp.zeros(Dc, jnp.float32)),
         jnp.arange(group_size),
+        unroll=16,
     )
     sel_n = jnp.clip(nodes, 0, N - 1)
     x = jnp.zeros(N, jnp.int32).at[sel_n].add((nodes >= 0).astype(jnp.int32))
