@@ -35,6 +35,7 @@ small to amortize the trajectory fall back to the grouped path.
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple, Tuple
 
 import jax
@@ -79,7 +80,7 @@ J_CAP = 512
 # can't tell which path produced the result.
 PATH_COUNTS = {
     "sort": 0, "micro": 0, "scan": 0, "grouped": 0, "sort_fallback": 0,
-    "domain": 0, "domain_fallback": 0,
+    "domain": 0, "domain_fallback": 0, "domain_pallas": 0,
 }
 
 # Max combined (domain-tuple, eligibility) classes for the domain-merge path;
@@ -735,15 +736,17 @@ def _spread_norm(raw: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(mx > 0, (mx - raw) * 100.0 / jnp.maximum(mx, 1e-9), 100.0)
 
 
-def _hard_spread_ok(dom, cnt, st: SpreadTables, skew, has_key, f_spread_on):
+def _hard_spread_ok(dom, cnt, in_key_cd, hard_c, skew, has_key, f_spread_on):
     """DoNotSchedule skew verdict (mirror kernels.spread_mask via the
     reconstructed dom — integer-exact, so bit-identical). `cnt`/`has_key`
     are per-(constraint, node) in the micro body and per-(constraint, class)
-    in the domain path; the arithmetic is identical."""
-    min_dom = jnp.min(jnp.where(st.in_key_cd, dom, jnp.inf), axis=1)
+    in the domain path; the arithmetic is identical. Mask args are bool —
+    the ONE definition shared by the micro body, the XLA domain scan and
+    the Pallas kernel (the exactness contract depends on it)."""
+    min_dom = jnp.min(jnp.where(in_key_cd, dom, jnp.inf), axis=1)
     min_c = jnp.where(jnp.isfinite(min_dom), min_dom, 0.0)
     ok = ((cnt + 1.0 - min_c[:, None]) <= skew[:, None] + _EPS) & has_key
-    return jnp.all(jnp.where(st.hard_c[:, None], ok, True), axis=0) | ~f_spread_on
+    return jnp.all(jnp.where(hard_c[:, None], ok, True), axis=0) | ~f_spread_on
 
 
 def _light_scan_micro(
@@ -792,7 +795,8 @@ def _light_scan_micro(
         score = cur_s + w_sp * sp                                 # -inf stays
         if flags.any_hard_spread:
             spread_ok = _hard_spread_ok(
-                dom, cnt, st, pod.spread_skew, has_key_cn, fo[F_SPREAD]
+                dom, cnt, st.in_key_cd, st.hard_c, pod.spread_skew,
+                has_key_cn, fo[F_SPREAD],
             )
             score = jnp.where(spread_ok, score, -jnp.inf)
         node = jnp.argmax(score)
@@ -883,7 +887,9 @@ def _domain_plan(
     )
 
 
-@functools.partial(jax.jit, static_argnames=("group_size", "l_cap", "flags"))
+@functools.partial(
+    jax.jit, static_argnames=("group_size", "l_cap", "flags", "use_pallas")
+)
 def domain_select(
     ns: NodeStatic,
     traj: Trajectory,
@@ -905,6 +911,7 @@ def domain_select(
     valid_count: jnp.ndarray,
     filter_on=None,
     flags: GroupFlags = ALL_DYNAMIC,
+    use_pallas: bool = False,
 ):
     """Whole-group selection with an O(Dc) scan state for micro-eligible
     groups (topology spread the only carry-coupled term, non-hostname keys).
@@ -985,7 +992,8 @@ def domain_select(
         total = hs + w_sp * sp
         if flags.any_hard_spread:
             spread_ok = _hard_spread_ok(
-                dom, cnt_cm, st, pod.spread_skew, has_key_cm, fo[F_SPREAD]
+                dom, cnt_cm, st.in_key_cd, st.hard_c, pod.spread_skew,
+                has_key_cm, fo[F_SPREAD],
             )
             total = jnp.where(spread_ok, total, -jnp.inf)
         node_h = jnp.take_along_axis(hnode, hc, axis=1)[:, 0]
@@ -1001,18 +1009,154 @@ def domain_select(
             y + oh.astype(jnp.float32) * elig_combo,
         ), (node_out.astype(jnp.int32), j_out.astype(jnp.int32))
 
-    # The step body is tiny ([Dc]-sized ops), so per-iteration dispatch
-    # overhead dominates — unrolling amortizes it without changing the op
-    # sequence (group_size is a multiple of 16: _bucket_light floors at 32).
-    _, (nodes, jidxs) = jax.lax.scan(
-        step,
-        (jnp.zeros(Dc, jnp.int32), jnp.zeros(Dc, jnp.float32)),
-        jnp.arange(group_size),
-        unroll=16,
-    )
+    if use_pallas:
+        # The whole pop loop as one fused on-core kernel (VMEM head tables,
+        # scratch state) — no per-iteration dispatch at all.
+        nodes, jidxs = _domain_pop_pallas(
+            hscore, hnode, hj, cap_eff, elig_combo, combo_valid, st,
+            t_onehot, has_key_cm, pod.spread_skew, w_sp, fo[F_SPREAD],
+            valid_count, group_size, flags.any_hard_spread, N,
+        )
+    else:
+        # The step body is tiny ([Dc]-sized ops), so per-iteration dispatch
+        # overhead dominates — unrolling amortizes it without changing the
+        # op sequence (group_size is a multiple of 16: _bucket_light floors
+        # at 32).
+        _, (nodes, jidxs) = jax.lax.scan(
+            step,
+            (jnp.zeros(Dc, jnp.int32), jnp.zeros(Dc, jnp.float32)),
+            jnp.arange(group_size),
+            unroll=16,
+        )
     sel_n = jnp.clip(nodes, 0, N - 1)
     x = jnp.zeros(N, jnp.int32).at[sel_n].add((nodes >= 0).astype(jnp.int32))
     return mono_ok, nodes, jidxs, x
+
+
+def _pallas_requested() -> bool:
+    """OSIM_PALLAS=1 routes the domain-select pop loop through the fused
+    Pallas kernel (_domain_pop_pallas); 0/unset keeps the XLA scan. Off by
+    default until the kernel is validated on the real TPU — the interpret
+    path is exercised by tests on CPU either way."""
+    return os.environ.get("OSIM_PALLAS", "0") == "1"
+
+
+def _domain_pop_pallas(
+    hscore, hnode, hj, cap_eff, elig_combo, combo_valid, st: SpreadTables,
+    t_onehot, has_key_cm, skew, w_sp, fo_spread, valid_count, group_size,
+    any_hard: bool, big_n: int,
+):
+    """The domain-merge pop loop as ONE Pallas kernel: head tables live in
+    VMEM, the [Dc] state (head pointers, commit counts, current head
+    score/node/lane) lives in scratch, and the whole sequential selection
+    runs on-core — no per-iteration XLA dispatch at all. Arithmetic is the
+    XLA scan body's, expression for expression (same f32 ops on the same
+    values → bit-identical totals; the oracle-parity suite runs this kernel
+    in interpret mode). Returns (nodes i32[G], jidx i32[G])."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    Dc, L = hscore.shape
+    C, D, _ = t_onehot.shape
+    inf = jnp.inf
+
+    def kernel(
+        iparams_ref, fparams_ref,
+        hscore_ref, hnode_ref, hj_ref, cap_ref, elig_ref, cvalid_ref,
+        base_dom_ref, t_ref, match_ref, soft_ref, hard_ref, skew_ref,
+        haskey_ref, inkey_ref,
+        nodes_ref, jidx_ref,
+        h_ref, y_ref, hs_ref, nd_ref, jv_ref,
+    ):
+        cap0 = cap_ref[0, :]
+        h_ref[0, :] = jnp.zeros((Dc,), jnp.int32)
+        y_ref[0, :] = jnp.zeros((Dc,), jnp.float32)
+        hs_ref[0, :] = jnp.where(cap0 > 0, hscore_ref[:, 0], -inf)
+        nd_ref[0, :] = hnode_ref[:, 0]
+        jv_ref[0, :] = hj_ref[:, 0]
+        w_sp_s = fparams_ref[0, 0]
+        valid_count_s = iparams_ref[0, 0]
+        fo_spread_on = iparams_ref[0, 1] > 0
+        bign = iparams_ref[0, 2]
+
+        def body(i, _):
+            y = y_ref[0, :]
+            dom = base_dom_ref[:, :] + match_ref[0, :][:, None] * jnp.sum(
+                t_ref[:, :, :] * y[None, None, :], axis=2
+            )                                                     # [C,D]
+            cnt = jnp.sum(dom[:, :, None] * t_ref[:, :, :], axis=1)  # [C,Dc]
+            raw = jnp.sum(
+                jnp.where(soft_ref[0, :][:, None] > 0, cnt, 0.0), axis=0
+            )                                                     # [Dc]
+            sp = _spread_norm(raw, cvalid_ref[0, :] > 0)
+            total = hs_ref[0, :] + w_sp_s * sp
+            if any_hard:
+                spread_ok = _hard_spread_ok(
+                    dom, cnt, inkey_ref[:, :] > 0, hard_ref[0, :] > 0,
+                    skew_ref[0, :], haskey_ref[:, :] > 0, fo_spread_on,
+                )
+                total = jnp.where(spread_ok, total, -inf)
+            mx_t = jnp.max(total)
+            key = jnp.where(total == mx_t, nd_ref[0, :], bign)[None, :]
+            m = jnp.argmin(key, axis=1)[0]
+            ok = (mx_t > -inf) & (i < valid_count_s)
+            nodes_ref[0, i] = jnp.where(ok, nd_ref[0, m], -1)
+            jidx_ref[0, i] = jnp.where(ok, jv_ref[0, m], 0)
+
+            @pl.when(ok)
+            def _():
+                nh = h_ref[0, m] + 1
+                h_ref[0, m] = nh
+                y_ref[0, m] = y_ref[0, m] + elig_ref[0, m]
+                nhc = jnp.minimum(nh, L - 1)
+                alive = nh < cap_ref[0, m]
+                hs_ref[0, m] = jnp.where(alive, hscore_ref[m, nhc], -inf)
+                nd_ref[0, m] = hnode_ref[m, nhc]
+                jv_ref[0, m] = hj_ref[m, nhc]
+
+            return 0
+
+        jax.lax.fori_loop(0, group_size, body, 0)
+
+    iparams = jnp.stack(
+        [valid_count.astype(jnp.int32), fo_spread.astype(jnp.int32),
+         jnp.int32(big_n)]
+    )[None, :]
+    fparams = jnp.stack([w_sp.astype(jnp.float32)])[None, :]
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    nodes, jidxs = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((1, group_size), jnp.int32),
+            jax.ShapeDtypeStruct((1, group_size), jnp.int32),
+        ),
+        in_specs=[smem, smem] + [vmem] * 14,
+        out_specs=(vmem, vmem),
+        scratch_shapes=[
+            pltpu.VMEM((1, Dc), jnp.int32),
+            pltpu.VMEM((1, Dc), jnp.float32),
+            pltpu.VMEM((1, Dc), jnp.float32),
+            pltpu.VMEM((1, Dc), jnp.int32),
+            pltpu.VMEM((1, Dc), jnp.int32),
+        ],
+        # Mosaic lowering exists only on TPU; everywhere else (CPU tests,
+        # GPU installs) the interpreter runs the same kernel logic instead
+        # of crashing at trace time.
+        interpret=jax.default_backend() != "tpu",
+    )(
+        iparams, fparams, hscore, hnode, hj,
+        cap_eff[None, :].astype(jnp.int32),
+        elig_combo[None, :].astype(jnp.float32),
+        combo_valid[None, :].astype(jnp.float32),
+        st.base_dom, t_onehot.astype(jnp.float32),
+        st.match_c[None, :], st.active_c[None, :].astype(jnp.float32),
+        st.hard_c[None, :].astype(jnp.float32), skew[None, :],
+        has_key_cm.astype(jnp.float32),
+        (st.in_key_cd.astype(jnp.float32) if any_hard
+         else jnp.zeros((C, D), jnp.float32)),
+    )
+    return nodes[0], jidxs[0]
 
 
 @functools.partial(jax.jit, static_argnames=("flags",))
@@ -1271,15 +1415,17 @@ def schedule_batch_fast(
             if plan is not None:
                 g = _bucket_light(length)
                 l_cap = _bucket_light(min(int(plan.counts.max()), length))
+                use_pallas = _pallas_requested()
                 mono, nodes_w, jidx_w, x_w = domain_select(
                     ns, traj, carry, row, static_ok, static_scores, na_ok,
                     weights, plan.combo_of_node, plan.counts, plan.offsets,
                     plan.elig_combo, plan.combo_valid, plan.t_onehot,
                     plan.has_key, g, l_cap, jnp.int32(length), filter_on,
-                    flags,
+                    flags, use_pallas,
                 )
                 if bool(mono):
                     PATH_COUNTS["domain"] += 1
+                    PATH_COUNTS["domain_pallas"] += int(use_pallas)
                     nodes_d = nodes_w[:length]
                     jidx_d = jidx_w[:length]
                     x = x_w

@@ -721,6 +721,51 @@ def test_spread_missing_key_nodes(spread_path):
     _assert_spread_path(nodes, tmpl, 80, spread_path)
 
 
+@pytest.mark.parametrize("hard", [True, False])
+def test_domain_pallas_kernel_parity(monkeypatch, hard):
+    """OSIM_PALLAS=1 routes the domain pop loop through the fused Pallas
+    kernel (interpret mode on CPU) — placements, reasons, takes and carry
+    must stay exactly oracle-identical, for both kernel variants (with and
+    without the DoNotSchedule hard-mask branch)."""
+    from open_simulator_tpu.ops import fast
+
+    monkeypatch.setenv("OSIM_PALLAS", "1")
+    nodes = [
+        _node(
+            f"n-{i}", cpu="4" if i < 3 else "32", pods="12",
+            labels={"topology.kubernetes.io/zone": f"z-{i % 3}"},
+        )
+        for i in range(9)
+    ]
+    constraints = [
+        {
+            "maxSkew": 4,
+            "topologyKey": "topology.kubernetes.io/zone",
+            "whenUnsatisfiable": "ScheduleAnyway",
+            "labelSelector": {"matchLabels": {"app": "hard"}},
+        }
+    ]
+    if hard:
+        constraints.insert(0, {
+            "maxSkew": 1,
+            "topologyKey": "topology.kubernetes.io/zone",
+            "whenUnsatisfiable": "DoNotSchedule",
+            "labelSelector": {"matchLabels": {"app": "hard"}},
+        })
+    tmpl = _pod(
+        "t",
+        cpu="500m",
+        labels={"app": "hard"},
+        spec_extra={"topologySpreadConstraints": constraints},
+    )
+    ns, carry, batch = _encode(nodes, [tmpl], [120])
+    before = dict(fast.PATH_COUNTS)
+    _assert_identical(ns, carry, batch)
+    # domain_pallas proves the kernel (not the XLA scan) actually produced
+    # the parity-checked result
+    assert fast.PATH_COUNTS["domain_pallas"] > before["domain_pallas"]
+
+
 def test_domain_cap_falls_back_to_micro():
     """A group spanning more combined classes than DM_CAP must take the
     micro scan (the [Dc] state would not beat it), still exact."""
