@@ -1383,27 +1383,65 @@ def schedule_batch_fast(
         traj, static_ok, static_ff, static_scores, na_ok = build_trajectory(
             ns, carry, row, weights, j_steps, filter_on
         )
+        sl = slice(start, start + length)
+
+        def finish(nodes_dev, jidx_dev, x_dev, mono_dev=None):
+            """Dispatch the group's whole tail (takes, failure-suffix reason
+            row, exit carry) and fetch every host-needed value in ONE
+            device_get — each host sync pays a full tunnel round trip, so
+            the reason row is computed speculatively (one cheap kernel
+            instead of a second sync when failures exist) and the sort/
+            domain mono verdict rides the same fetch (on False the caller
+            discards everything fetched and replays with a scan)."""
+            take_dev, vg_dev, dev_dev = gather_takes(traj, nodes_dev, jidx_dev)
+            reason_dev = light_reasons(
+                ns, carry, row, static_ok, static_ff, static_scores,
+                na_ok, weights, x_dev, cur_at(traj, x_dev), filter_on, flags,
+            )
+            carry_dev = exit_carry(ns, carry, row, traj, x_dev)
+            mono_np, *got = jax.device_get(
+                (jnp.bool_(True) if mono_dev is None else mono_dev,
+                 nodes_dev, take_dev, vg_dev, dev_dev, reason_dev)
+            )
+            return bool(mono_np), tuple(got), carry_dev
+
+        def commit(got, carry_dev):
+            nonlocal carry
+            nodes_np, take_np, vg_np, dev_np, reason_np = got
+            nodes_out[sl] = nodes_np
+            take_out[sl] = take_np.astype(np.int32)
+            vg_out[sl] = vg_np
+            dev_out[sl] = dev_np
+            if (nodes_np < 0).any():
+                # A failed step commits nothing, so the whole failure suffix
+                # of the group shares one state — one reason row covers it.
+                reasons_out[sl][nodes_np < 0] = reason_np
+            carry = carry_dev
+
+        committed = False
 
         # Sort path: whole group in one device call when scores are purely
-        # node-local and per-node non-increasing (checked on device).
-        sorted_ok = False
+        # node-local and per-node non-increasing (checked on device; the
+        # check's verdict is fetched together with the speculated tail).
         out_size = _bucket_light(length)
         if _sortable(flags) and out_size <= N * j_steps:
             mono, nodes_d, jidx_d, x = sort_select(
                 ns, traj, row, static_ok, static_scores, weights,
                 jnp.int32(length), out_size, filter_on,
             )
-            if bool(mono):
-                sorted_ok = True
-                nodes_d = nodes_d[:length]
-                jidx_d = jidx_d[:length]
+            mono_ok, got, carry_dev = finish(
+                nodes_d[:length], jidx_d[:length], x, mono
+            )
+            if mono_ok:
+                PATH_COUNTS["sort"] += 1
+                commit(got, carry_dev)
+                committed = True
             else:
                 # a balanced-allocation rise broke monotonicity — the merge
                 # argument doesn't hold, replay with the scan below
                 PATH_COUNTS["sort_fallback"] += 1
 
-        domain_done = False
-        if not sorted_ok and flags.micro_spread:
+        if not committed and flags.micro_spread:
             # Domain-merge path: O(Dc) scan state instead of O(N). The class
             # partition needs the pod's spread eligibility on host (one small
             # bool[N] transfer per group).
@@ -1423,23 +1461,20 @@ def schedule_batch_fast(
                     plan.has_key, g, l_cap, jnp.int32(length), filter_on,
                     flags, use_pallas,
                 )
-                if bool(mono):
+                mono_ok, got, carry_dev = finish(
+                    nodes_w[:length], jidx_w[:length], x_w, mono
+                )
+                if mono_ok:
                     PATH_COUNTS["domain"] += 1
                     PATH_COUNTS["domain_pallas"] += int(use_pallas)
-                    nodes_d = nodes_w[:length]
-                    jidx_d = jidx_w[:length]
-                    x = x_w
-                    domain_done = True
+                    commit(got, carry_dev)
+                    committed = True
                 else:
                     # a rising lane sequence voids the within-class merge
                     # argument — replay with the micro scan
                     PATH_COUNTS["domain_fallback"] += 1
 
-        if sorted_ok:
-            PATH_COUNTS["sort"] += 1
-        elif domain_done:
-            pass
-        else:
+        if not committed:
             PATH_COUNTS["micro" if flags.micro_spread else "scan"] += 1
             x = jnp.zeros(N, jnp.int32)
             chunks = []
@@ -1458,25 +1493,7 @@ def schedule_batch_fast(
             # the host-side cost at TPU-tunnel latencies).
             nodes_d = jnp.concatenate([c[1][: c[0]] for c in chunks])
             jidx_d = jnp.concatenate([c[2][: c[0]] for c in chunks])
-
-        # shared tail: takes, output writes, failure-suffix reasons, carry
-        take_d, vg_d, dev_d = gather_takes(traj, nodes_d, jidx_d)
-        sl = slice(start, start + length)
-        nodes_np = np.asarray(nodes_d)
-        nodes_out[sl] = nodes_np
-        take_out[sl] = np.asarray(take_d).astype(np.int32)
-        vg_out[sl] = np.asarray(vg_d)
-        dev_out[sl] = np.asarray(dev_d)
-        if (nodes_np < 0).any():
-            # A failed step commits nothing, so the whole failure suffix of
-            # the group shares one state — attribute reasons once.
-            reason_row = np.asarray(
-                light_reasons(
-                    ns, carry, row, static_ok, static_ff, static_scores,
-                    na_ok, weights, x, cur_at(traj, x), filter_on, flags,
-                )
-            )
-            reasons_out[sl][nodes_np < 0] = reason_row
-        carry = exit_carry(ns, carry, row, traj, x)
+            _, got, carry_dev = finish(nodes_d, jidx_d, x)
+            commit(got, carry_dev)
 
     return carry, nodes_out, reasons_out, take_out, vg_out, dev_out
