@@ -286,14 +286,17 @@ def group_runs(batch: PodBatch) -> List[Tuple[int, int]]:
     if total == 0:
         return []
     sig = _row_signature(batch)
-    runs: List[Tuple[int, int]] = []
-    start = 0
-    for i in range(1, total):
-        if sig[i] != sig[i - 1]:
-            runs.append((start, i - start))
-            start = i
-    runs.append((start, total - start))
-    return runs
+    # Vectorized boundary detection: per-element comparison of structured
+    # rows re-promotes the dtype 100k times (~0.8 s at headline scale).
+    if sig.dtype.fields:
+        a, b = sig["a"][:total], sig["b"][:total]
+        diff = (a[1:] != a[:-1]) | (b[1:] != b[:-1])
+    else:
+        diff = sig[1:total] != sig[: total - 1]
+    change = np.nonzero(diff)[0] + 1
+    starts = np.concatenate(([0], change))
+    ends = np.concatenate((change, [total]))
+    return [(int(s), int(e - s)) for s, e in zip(starts, ends)]
 
 
 def _bucket(n: int) -> int:
