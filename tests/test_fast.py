@@ -37,15 +37,15 @@ from open_simulator_tpu.ops.state import (
 from open_simulator_tpu.ops.tile import tile_pod_batch
 
 
-def _assert_identical(ns, carry0, batch, force_fast=True):
+def _assert_identical(ns, carry0, batch, force_fast=True, filter_on=None):
     """Run oracle + fast path on the same state; demand exact equality."""
     w = weights_array()
     rows = pod_rows_from_batch(batch)
     carry_ref, nodes_ref, reasons_ref, take_ref, vg_ref, dev_ref = schedule_batch(
-        ns, carry0, rows, w
+        ns, carry0, rows, w, filter_on=filter_on
     )
     carry_f, nodes_f, reasons_f, take_f, vg_f, dev_f = schedule_batch_fast(
-        ns, carry0, batch, w, force_fast=force_fast
+        ns, carry0, batch, w, force_fast=force_fast, filter_on=filter_on
     )
     total = int(batch.valid.sum())
     np.testing.assert_array_equal(np.asarray(nodes_ref)[:total], nodes_f[:total])
@@ -764,6 +764,85 @@ def test_domain_pallas_kernel_parity(monkeypatch, hard):
     # domain_pallas proves the kernel (not the XLA scan) actually produced
     # the parity-checked result
     assert fast.PATH_COUNTS["domain_pallas"] > before["domain_pallas"]
+
+
+def test_spread_with_host_ports(spread_path):
+    """hostPort pods under zone spread: ports are node-local (still
+    domain-eligible), each node takes exactly one pod before its port
+    conflicts with itself — the lane feasibility must gate identically on
+    both spread strategies."""
+    nodes = [
+        _node(
+            f"n-{i}", cpu="32", pods="20",
+            labels={"topology.kubernetes.io/zone": f"z-{i % 3}"},
+        )
+        for i in range(6)
+    ]
+    tmpl = _pod(
+        "t",
+        cpu="500m",
+        labels={"app": "hp"},
+        spec_extra={
+            "containers": [
+                {
+                    "name": "c",
+                    "resources": {"requests": {"cpu": "500m", "memory": "512Mi"}},
+                    "ports": [{"containerPort": 80, "hostPort": 8080}],
+                }
+            ],
+            "topologySpreadConstraints": [
+                {
+                    "maxSkew": 2,
+                    "topologyKey": "topology.kubernetes.io/zone",
+                    "whenUnsatisfiable": "ScheduleAnyway",
+                    "labelSelector": {"matchLabels": {"app": "hp"}},
+                }
+            ],
+        },
+    )
+    nodes_out = _assert_spread_path(nodes, tmpl, 10, spread_path)
+    # one pod per node (port self-conflict), 4 overflow
+    placed = nodes_out[:10][nodes_out[:10] >= 0]
+    assert len(placed) == 6 and len(set(placed.tolist())) == 6
+
+
+def test_spread_filter_disabled_profile(spread_path):
+    """A scheduler profile disabling PodTopologySpread must neutralize the
+    DoNotSchedule mask on the domain path exactly as on the micro scan
+    (the `| ~filter_on[F_SPREAD]` branch)."""
+    import jax.numpy as jnp
+
+    from open_simulator_tpu.ops import fast
+    from open_simulator_tpu.ops.kernels import F_SPREAD, NUM_FILTERS
+
+    nodes = [
+        _node(
+            f"n-{i}", cpu="32", pods="10",
+            labels={"topology.kubernetes.io/zone": f"z-{i % 3}"},
+        )
+        for i in range(6)
+    ]
+    tmpl = _pod(
+        "t",
+        cpu="500m",
+        labels={"app": "nofilter"},
+        spec_extra={
+            "topologySpreadConstraints": [
+                {
+                    "maxSkew": 1,
+                    "topologyKey": "topology.kubernetes.io/zone",
+                    "whenUnsatisfiable": "DoNotSchedule",
+                    "labelSelector": {"matchLabels": {"app": "nofilter"}},
+                }
+            ]
+        },
+    )
+    ns, carry, batch = _encode(nodes, [tmpl], [40])
+    fo = jnp.ones(NUM_FILTERS, bool).at[F_SPREAD].set(False)
+    before = dict(fast.PATH_COUNTS)
+    _assert_identical(ns, carry, batch, filter_on=fo)
+    key = "domain" if spread_path == "domain" else "micro"
+    assert fast.PATH_COUNTS[key] > before[key]
 
 
 def test_domain_cap_falls_back_to_micro():
