@@ -220,6 +220,11 @@ class GroupFlags(NamedTuple):
     # uses non-hostname keys: the selection step reduces to partial9 +
     # w*spread with a small domain-count carry (the micro body)
     micro_spread: bool = False
+    # EVERY carry-coupled term (spread, required/preferred inter-pod
+    # affinity, anti-affinity symmetry) is domain-keyed over non-hostname
+    # keys and there are no gpu/storage dynamics: the whole selection
+    # reduces to the per-class domain path (domain_select)
+    domain_aff: bool = False
 
 
 ALL_DYNAMIC = GroupFlags(*([True] * 8))
@@ -230,6 +235,7 @@ def group_flags(row_np: dict, anti_topo_np: np.ndarray) -> GroupFlags:
     spread_active = row_np["spread_topo"] >= 0
     soft = spread_active & ~row_np["spread_hard"]
     aff_active = row_np["aff_topo"] >= 0
+    anti_match = (anti_topo_np >= 0) & row_np["match_anti"]
     f = GroupFlags(
         dyn_ports=bool((row_np["hp_pid"] > 0).any()),
         dyn_storage=bool(row_np["has_local"]),
@@ -238,7 +244,18 @@ def group_flags(row_np: dict, anti_topo_np: np.ndarray) -> GroupFlags:
         any_soft_spread=bool(soft.any()),
         any_req_aff=bool((aff_active & row_np["aff_required"]).any()),
         any_pref_aff=bool((aff_active & ~row_np["aff_required"]).any()),
-        any_anti_sym=bool(((anti_topo_np >= 0) & row_np["match_anti"]).any()),
+        any_anti_sym=bool(anti_match.any()),
+    )
+    # hostname-keyed constraints count per node, not per domain — they keep
+    # the general body
+    keys_domainable = (
+        bool((row_np["spread_topo"][spread_active] > 0).all())
+        and bool((row_np["aff_topo"][aff_active] > 0).all())
+        and bool((anti_topo_np[anti_match] > 0).all())
+    )
+    any_coupled = (
+        f.any_soft_spread or f.any_hard_spread or f.any_req_aff
+        or f.any_pref_aff or f.any_anti_sym
     )
     micro = (
         (f.any_soft_spread or f.any_hard_spread)
@@ -247,11 +264,15 @@ def group_flags(row_np: dict, anti_topo_np: np.ndarray) -> GroupFlags:
         and not f.any_anti_sym
         and not f.dyn_gpu
         and not f.dyn_storage
-        # hostname-keyed constraints count per node, not per domain — they
-        # keep the general body
-        and bool((row_np["spread_topo"][spread_active] > 0).all())
+        and keys_domainable
     )
-    return f._replace(micro_spread=micro)
+    domain_aff = (
+        any_coupled
+        and not f.dyn_gpu
+        and not f.dyn_storage
+        and keys_domainable
+    )
+    return f._replace(micro_spread=micro, domain_aff=domain_aff)
 
 
 def _light_eval(
@@ -581,9 +602,10 @@ def _hoisted_values(ns: NodeStatic, cur: jnp.ndarray, flags: GroupFlags) -> dict
 
 
 SP_IDX = WEIGHT_ORDER.index("topology_spread")
-assert SP_IDX == len(WEIGHT_ORDER) - 1, (
-    "the micro body's partial9 + w*spread split needs topology_spread LAST "
-    "in combine_scores' fold order"
+IPA_IDX = WEIGHT_ORDER.index("inter_pod_affinity")
+assert SP_IDX == len(WEIGHT_ORDER) - 1 and IPA_IDX == SP_IDX - 1, (
+    "the fast paths' partial-sum splits need the carry-coupled terms LAST "
+    "in combine_scores' fold order: ..., inter_pod_affinity, topology_spread"
 )
 
 
@@ -709,15 +731,18 @@ def _spread_tables(
     )
 
 
-def _lane_partials(ns, traj, pod, static_scores, static_ok, weights, fo):
-    """(p9, feas) per lane — partial9 is every score row except
-    topology_spread, combined by the shared left fold: `p9 + w_sp * sp` then
-    equals the full combine_scores result by construction (topology_spread
-    is last). Feasibility covers the only dynamics a micro-eligible group
-    has: ports and resources."""
+def _lane_partials(
+    ns, traj, pod, static_scores, static_ok, weights, fo, prefix_end=SP_IDX
+):
+    """(partial, feas) per lane — the partial is the left-fold prefix of
+    combine_scores through WEIGHT_ORDER[:prefix_end] (SP_IDX for the micro
+    body's partial9, IPA_IDX for the domain path's partial8; `partial +
+    w_ipa*ipa + w_sp*sp` then equals the full fold by construction because
+    the coupled terms are last). Feasibility covers the only dynamics a
+    micro/domain-eligible group has: ports and resources."""
     p9 = combine_scores(
         _lane_rows(ns, traj, pod, static_scores), weights,
-        order=WEIGHT_ORDER[:SP_IDX],
+        order=WEIGHT_ORDER[:prefix_end],
     )                                                             # [N,J]
     feas = (
         static_ok[:, None]
@@ -844,23 +869,61 @@ class DomainPlan(NamedTuple):
     offsets: np.ndarray        # i32[Dc] class start in the combo-sorted order
     elig_combo: np.ndarray     # f32[Dc] 1.0 = class counts for spread
     combo_valid: np.ndarray    # bool[Dc] class holds >= 1 valid node
-    t_onehot: np.ndarray       # f32[C,D,Dc] domain membership per constraint
-    has_key: np.ndarray        # bool[C,Dc] class has constraint c's topo key
+    t_onehot: np.ndarray       # f32[C,D,Dc] spread-row domain membership
+    has_key: np.ndarray        # bool[C,Dc] class has spread row c's topo key
+    t_aff: np.ndarray          # f32[CA,D,Dc] affinity-row domain membership
+    has_key_aff: np.ndarray    # bool[CA,Dc]
+    t_anti: np.ndarray         # f32[AT,D,Dc] anti-sym-term domain membership
+    has_key_anti: np.ndarray   # bool[AT,Dc]
+
+
+def _map_onehot(keys_np, act, uniq_cols, col_of, dc, dc_pad, n_domains):
+    """(map, onehot, has_key) for one constraint family: map[r, m] = class
+    m's domain under row r's topo key (-1 when inactive / key missing)."""
+    R = keys_np.shape[0]
+    m = np.full((R, dc_pad), -1, np.int32)
+    act_rows = np.nonzero(act)[0]
+    if act_rows.size:
+        m[act_rows[:, None], np.arange(dc)[None, :]] = uniq_cols[
+            :, [col_of[int(keys_np[r])] for r in act_rows]
+        ].T
+    onehot = (
+        m[:, None, :] == np.arange(n_domains)[None, :, None]
+    ).astype(np.float32)
+    return m, onehot
 
 
 def _domain_plan(
     spread_topo_np: np.ndarray,
+    aff_topo_np: np.ndarray,
+    anti_topo_np: np.ndarray,
+    match_anti_np: np.ndarray,
     topo_np: np.ndarray,
     valid_np: np.ndarray,
     elig_np: np.ndarray,
     j_steps: int,
     n_domains: int,
 ):
-    """Partition nodes into combined domain classes; None when the group is
-    too fragmented (Dc > DM_CAP) to beat the micro scan."""
-    act = spread_topo_np >= 0
-    cols = topo_np[:, spread_topo_np[act]]                      # [N,A]
-    keymat = np.concatenate([cols, elig_np[:, None].astype(np.int32)], axis=1)
+    """Partition nodes into combined domain classes over EVERY coupled
+    term's topology key (spread rows, affinity rows, matching registered
+    anti-affinity terms) plus the spread-eligibility bit; None when the
+    group is too fragmented (Dc > DM_CAP) to beat the scan paths."""
+    s_act = spread_topo_np >= 0
+    a_act = aff_topo_np >= 0
+    t_act = (anti_topo_np >= 0) & match_anti_np
+    keys = np.unique(np.concatenate([
+        spread_topo_np[s_act], aff_topo_np[a_act], anti_topo_np[t_act],
+    ]))
+    col_of = {int(k): i for i, k in enumerate(keys)}
+    cols = topo_np[:, keys]                                     # [N,K']
+    # spread eligibility splits classes ONLY when a spread row consumes it —
+    # for pure-affinity groups it would just fragment Dc for nothing
+    if s_act.any():
+        keymat = np.concatenate(
+            [cols, elig_np[:, None].astype(np.int32)], axis=1
+        )
+    else:
+        keymat = cols
     uniq, inv = np.unique(keymat, axis=0, return_inverse=True)
     dc = uniq.shape[0]
     if dc > DM_CAP:
@@ -870,20 +933,25 @@ def _domain_plan(
     counts = (node_counts * j_steps).astype(np.int32)
     offsets = np.concatenate([[0], np.cumsum(counts)[:-1]]).astype(np.int32)
     elig_combo = np.zeros(dc_pad, np.float32)
-    elig_combo[:dc] = uniq[:, -1]
+    if s_act.any():
+        elig_combo[:dc] = uniq[:, -1]
+        uniq_cols = uniq[:, :-1]                                # [dc,K']
+    else:
+        uniq_cols = uniq  # elig column absent (unused without spread rows)
     combo_valid = np.zeros(dc_pad, bool)
     np.logical_or.at(combo_valid, inv, valid_np)
-    C = spread_topo_np.shape[0]
-    map_cd = np.full((C, dc_pad), -1, np.int32)
-    map_cd[np.nonzero(act)[0][:, None], np.arange(dc)[None, :]] = uniq[:, :-1].T
-    # -1 (inactive constraint / missing key) matches no domain id, so those
-    # columns are all-zero without an explicit mask.
-    t_onehot = (
-        map_cd[:, None, :] == np.arange(n_domains)[None, :, None]
-    ).astype(np.float32)
+    map_s, t_onehot = _map_onehot(
+        spread_topo_np, s_act, uniq_cols, col_of, dc, dc_pad, n_domains
+    )
+    map_a, t_aff = _map_onehot(
+        aff_topo_np, a_act, uniq_cols, col_of, dc, dc_pad, n_domains
+    )
+    map_t, t_anti = _map_onehot(
+        anti_topo_np, t_act, uniq_cols, col_of, dc, dc_pad, n_domains
+    )
     return DomainPlan(
         inv.astype(np.int32), counts, offsets, elig_combo, combo_valid,
-        t_onehot, map_cd >= 0,
+        t_onehot, map_s >= 0, t_aff, map_a >= 0, t_anti, map_t >= 0,
     )
 
 
@@ -906,6 +974,10 @@ def domain_select(
     combo_valid: jnp.ndarray,
     t_onehot: jnp.ndarray,
     has_key_cm: jnp.ndarray,
+    t_aff: jnp.ndarray,
+    has_key_aff: jnp.ndarray,
+    t_anti: jnp.ndarray,
+    has_key_anti: jnp.ndarray,
     group_size: int,
     l_cap: int,
     valid_count: jnp.ndarray,
@@ -913,8 +985,10 @@ def domain_select(
     flags: GroupFlags = ALL_DYNAMIC,
     use_pallas: bool = False,
 ):
-    """Whole-group selection with an O(Dc) scan state for micro-eligible
-    groups (topology spread the only carry-coupled term, non-hostname keys).
+    """Whole-group selection with an O(Dc) scan state for domain-eligible
+    groups: every carry-coupled term — topology spread, required/preferred
+    inter-pod affinity, anti-affinity symmetry — keyed by non-hostname
+    topology keys (flags.domain_aff), with no gpu/storage dynamics.
 
     Two structural facts shrink the scan from O(N) to O(Dc) per step:
       1. The spread term is DOMAIN-keyed: every node of a combined class
@@ -932,12 +1006,14 @@ def domain_select(
     lowest head node index, which equals the global argmax tie-break because
     each class head is its class's lowest-index maximum.
 
-    Exactness: head partials are the same f32 lane values, domain counts are
-    reconstructed with the micro body's own einsum arithmetic (exact integer
-    f32), and the spread normalization applies the identical expression —
-    so every per-step total is bit-identical to the micro scan's winning
-    score. mono_ok False (a lane sequence rose) voids fact 2; the caller
-    falls back to the micro scan, like the sort path.
+    Exactness: head partials are the same f32 lane values; domain counts
+    for spread, affinity and anti-symmetry are reconstructed with the
+    shared helpers' einsum arithmetic (exact integer f32); the spread and
+    min-max normalizations apply the identical expressions; and the fold
+    `(partial8 + w_ipa*ipa) + w_sp*sp` is combine_scores' own left
+    association — so every per-step total is bit-identical to the scan
+    bodies'. mono_ok False (a lane sequence rose) voids fact 2; the caller
+    falls back to the micro scan (spread-only groups) or the light scan.
 
     Returns (mono_ok, nodes i32[group_size], jidx i32[group_size], x i32[N]).
     """
@@ -945,10 +1021,13 @@ def domain_select(
     Dc = counts.shape[0]
     fo = jnp.ones(NUM_FILTERS, bool) if filter_on is None else filter_on
 
-    p9, feas = _lane_partials(
-        ns, traj, pod, static_scores, static_ok, weights, fo
+    # partial8 lanes: the fold prefix BEFORE both coupled terms; the step
+    # adds w_ipa*ipa(class) then w_sp*sp(class), reproducing the full fold.
+    p8, feas = _lane_partials(
+        ns, traj, pod, static_scores, static_ok, weights, fo,
+        prefix_end=IPA_IDX,
     )
-    score_lane = jnp.where(feas, p9, -jnp.inf)
+    score_lane = jnp.where(feas, p8, -jnp.inf)
     mono_ok = jnp.all(score_lane[:, 1:] <= score_lane[:, :-1])
 
     # Stable sort keyed (class asc, score desc): within a class, lanes land
@@ -972,30 +1051,136 @@ def domain_select(
     # the arithmetic cannot drift between the two bodies)
     st = _spread_tables(ns, carry0, pod, na_ok, flags)
     w_sp = weights[SP_IDX]
+    w_ipa = weights[IPA_IDX]
+    any_aff = flags.any_req_aff or flags.any_pref_aff or flags.any_anti_sym
+    valid_f = ns.valid.astype(jnp.float32)
+
+    if flags.any_req_aff or flags.any_pref_aff:
+        # inter-pod affinity tables (mirror _light_eval's one_aff/one_asc:
+        # _domain_counts with elig=None counts over ALL valid nodes)
+        k_a = jnp.maximum(pod.aff_topo, 0)
+        to_a = ns.topo_onehot[k_a]                                # [CA,D,N]
+        base_rows_a = carry0.sel_counts[pod.aff_sel]              # [CA,N]
+        match_a = pod.match_sel[pod.aff_sel].astype(jnp.float32)  # [CA]
+        counts0_a = jnp.where(valid_f > 0, base_rows_a, 0.0)
+        base_dom_a = jnp.einsum(
+            "cdn,cn->cd", to_a, counts0_a,
+            precision=jax.lax.Precision.HIGHEST,
+        )                                                         # [CA,D]
+        in_key_a = (ns.domain_key[None, :] == k_a[:, None]) & (
+            jnp.einsum(
+                "cdn,n->cd", to_a, valid_f,
+                precision=jax.lax.Precision.HIGHEST,
+            ) > 0.0
+        )                                                         # [CA,D]
+        req_t = (pod.aff_topo >= 0) & pod.aff_required
+        pref_t = (pod.aff_topo >= 0) & ~pod.aff_required
+        self_match_a = pod.match_sel[pod.aff_sel]                 # [CA] bool
+        any_pref_active = jnp.any(pref_t)
+    if flags.any_anti_sym:
+        # anti-affinity symmetry tables (mirror _light_eval's one_sym)
+        k_t = jnp.maximum(ns.anti_topo, 0)
+        to_t = ns.topo_onehot[k_t]                                # [AT,D,N]
+        counts0_t = jnp.where(valid_f > 0, carry0.anti_counts, 0.0)
+        base_dom_t = jnp.einsum(
+            "tdn,tn->td", to_t, counts0_t,
+            precision=jax.lax.Precision.HIGHEST,
+        )                                                         # [AT,D]
+        active_sym = (ns.anti_topo >= 0) & pod.match_anti         # [AT]
+
+    any_spread = flags.any_soft_spread or flags.any_hard_spread
 
     def step(carry_hy, i):
         h, y = carry_hy
-        dom = st.base_dom + st.match_c[:, None] * jnp.einsum(
-            "cdm,m->cd", t_onehot, y, precision=jax.lax.Precision.HIGHEST
-        )                                                         # [C,D]
-        cnt_cm = jnp.einsum(
-            "cd,cdm->cm", dom, t_onehot, precision=jax.lax.Precision.HIGHEST
-        )                                                         # [C,Dc]
-        raw = jnp.sum(jnp.where(st.active_c[:, None], cnt_cm, 0.0), axis=0)
-        sp = _spread_norm(raw, combo_valid)                       # [Dc]
+        if any_spread:
+            y_elig = y * elig_combo
+            dom = st.base_dom + st.match_c[:, None] * jnp.einsum(
+                "cdm,m->cd", t_onehot, y_elig,
+                precision=jax.lax.Precision.HIGHEST,
+            )                                                     # [C,D]
+            cnt_cm = jnp.einsum(
+                "cd,cdm->cm", dom, t_onehot,
+                precision=jax.lax.Precision.HIGHEST,
+            )                                                     # [C,Dc]
+            raw = jnp.sum(
+                jnp.where(st.active_c[:, None], cnt_cm, 0.0), axis=0
+            )
+            sp = _spread_norm(raw, combo_valid)                   # [Dc]
+        else:
+            # no active spread row: raw ≡ 0 => the 100.0 branch (the same
+            # prune _light_eval applies)
+            sp = jnp.full(Dc, 100.0)
         hc = jnp.clip(h, 0, l_cap - 1)[:, None]
         hs = jnp.where(
             h < cap_eff,
             jnp.take_along_axis(hscore, hc, axis=1)[:, 0],
             -jnp.inf,
         )
-        total = hs + w_sp * sp
+
+        ipa = jnp.zeros(Dc)
+        aff_ok = jnp.ones(Dc, bool)
+        if flags.any_req_aff or flags.any_pref_aff:
+            # every pod of the group is identical, so its commits add
+            # match_a per class commit to the row's selector counts
+            dom_a = base_dom_a + match_a[:, None] * jnp.einsum(
+                "cdm,m->cd", t_aff, y, precision=jax.lax.Precision.HIGHEST
+            )                                                     # [CA,D]
+            cnt_a = jnp.einsum(
+                "cd,cdm->cm", dom_a, t_aff,
+                precision=jax.lax.Precision.HIGHEST,
+            )                                                     # [CA,Dc]
+            if flags.any_req_aff:
+                total_a = jnp.sum(
+                    jnp.where(in_key_a, dom_a, 0.0), axis=1
+                )                                                 # [CA]
+                feasible = (cnt_a > 0) | (
+                    self_match_a[:, None] & (total_a[:, None] == 0)
+                )
+                feasible = feasible & has_key_aff
+                ok_t = jnp.where(
+                    pod.aff_anti[:, None], cnt_a == 0, feasible
+                )
+                aff_ok = aff_ok & jnp.all(
+                    jnp.where(req_t[:, None], ok_t, True), axis=0
+                )
+            if flags.any_pref_aff:
+                signed = jnp.where(
+                    pod.aff_anti, -pod.aff_weight, pod.aff_weight
+                )[:, None] * cnt_a
+                raw_a = jnp.sum(
+                    jnp.where(pref_t[:, None], signed, 0.0), axis=0
+                )                                                 # [Dc]
+                # the oracle's own normalization over valid classes
+                ipa = jnp.where(
+                    any_pref_active,
+                    _minmax_normalize(raw_a, combo_valid),
+                    0.0,
+                )
+        if flags.any_anti_sym:
+            dom_t = base_dom_t + pod.own_anti[:, None] * jnp.einsum(
+                "tdm,m->td", t_anti, y, precision=jax.lax.Precision.HIGHEST
+            )                                                     # [AT,D]
+            cnt_t = jnp.einsum(
+                "td,tdm->tm", dom_t, t_anti,
+                precision=jax.lax.Precision.HIGHEST,
+            )                                                     # [AT,Dc]
+            ok_t = (cnt_t == 0) | ~has_key_anti
+            aff_ok = aff_ok & jnp.all(
+                jnp.where(active_sym[:, None], ok_t, True), axis=0
+            )
+
+        # the full fold: ((partial8 + w_ipa*ipa) + w_sp*sp)
+        total = (hs + w_ipa * ipa) + w_sp * sp
         if flags.any_hard_spread:
             spread_ok = _hard_spread_ok(
                 dom, cnt_cm, st.in_key_cd, st.hard_c, pod.spread_skew,
                 has_key_cm, fo[F_SPREAD],
             )
             total = jnp.where(spread_ok, total, -jnp.inf)
+        if any_aff:
+            total = jnp.where(
+                aff_ok | ~fo[F_POD_AFFINITY], total, -jnp.inf
+            )
         node_h = jnp.take_along_axis(hnode, hc, axis=1)[:, 0]
         j_h = jnp.take_along_axis(hj, hc, axis=1)[:, 0]
         mx_t = jnp.max(total)
@@ -1006,7 +1191,7 @@ def domain_select(
         oh = (jnp.arange(Dc) == m) & ok
         return (
             h + oh.astype(jnp.int32),
-            y + oh.astype(jnp.float32) * elig_combo,
+            y + oh.astype(jnp.float32),
         ), (node_out.astype(jnp.int32), j_out.astype(jnp.int32))
 
     if use_pallas:
@@ -1441,25 +1626,31 @@ def schedule_batch_fast(
                 # argument doesn't hold, replay with the scan below
                 PATH_COUNTS["sort_fallback"] += 1
 
-        if not committed and flags.micro_spread:
+        if not committed and flags.domain_aff:
             # Domain-merge path: O(Dc) scan state instead of O(N). The class
             # partition needs the pod's spread eligibility on host (one small
             # bool[N] transfer per group).
             elig_np = np.asarray(na_ok) & valid_np
             plan = _domain_plan(
-                batch.spread_topo[start], topo_np, valid_np, elig_np,
-                j_steps, n_domains,
+                batch.spread_topo[start], batch.aff_topo[start],
+                anti_topo_np, batch.match_anti[start], topo_np, valid_np,
+                elig_np, j_steps, n_domains,
             )
             if plan is not None:
                 g = _bucket_light(length)
                 l_cap = _bucket_light(min(int(plan.counts.max()), length))
-                use_pallas = _pallas_requested()
+                # the Pallas kernel implements the spread-only step body
+                use_pallas = _pallas_requested() and not (
+                    flags.any_req_aff or flags.any_pref_aff
+                    or flags.any_anti_sym
+                )
                 mono, nodes_w, jidx_w, x_w = domain_select(
                     ns, traj, carry, row, static_ok, static_scores, na_ok,
                     weights, plan.combo_of_node, plan.counts, plan.offsets,
                     plan.elig_combo, plan.combo_valid, plan.t_onehot,
-                    plan.has_key, g, l_cap, jnp.int32(length), filter_on,
-                    flags, use_pallas,
+                    plan.has_key, plan.t_aff, plan.has_key_aff, plan.t_anti,
+                    plan.has_key_anti, g, l_cap, jnp.int32(length),
+                    filter_on, flags, use_pallas,
                 )
                 mono_ok, got, carry_dev = finish(
                     nodes_w[:length], jidx_w[:length], x_w, mono

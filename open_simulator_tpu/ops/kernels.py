@@ -97,7 +97,16 @@ DEFAULT_WEIGHTS = {
     "gpu_share": 1.0,
     "open_local": 1.0,
 }
-WEIGHT_ORDER = tuple(sorted(DEFAULT_WEIGHTS))
+# Fold order: the two carry-coupled terms come LAST (inter_pod_affinity,
+# then topology_spread) so the fast paths' partial-sum prefix splits are
+# exact left-fold prefixes (ops/fast.py: partial8 + w_ipa*ipa + w_sp*sp);
+# node-local terms keep alphabetical order among themselves. Every path —
+# naive scan, grouped, sort/micro/domain — folds in this one order, so the
+# f32 summation (and every tie-break) stays internally consistent.
+WEIGHT_ORDER = tuple(
+    sorted(k for k in DEFAULT_WEIGHTS
+           if k not in ("inter_pod_affinity", "topology_spread"))
+) + ("inter_pod_affinity", "topology_spread")
 
 
 def weights_array(weights: dict = DEFAULT_WEIGHTS) -> jnp.ndarray:

@@ -845,6 +845,181 @@ def test_spread_filter_disabled_profile(spread_path):
     assert fast.PATH_COUNTS[key] > before[key]
 
 
+def _assert_domain_fires(nodes, tmpls, counts):
+    from open_simulator_tpu.ops import fast
+
+    ns, carry, batch = _encode(nodes, tmpls, counts)
+    before = dict(fast.PATH_COUNTS)
+    out = _assert_identical(ns, carry, batch)
+    assert fast.PATH_COUNTS["domain"] > before["domain"], (
+        f"expected the domain path; deltas "
+        f"{ {k: fast.PATH_COUNTS[k] - before[k] for k in before} }"
+    )
+    return out
+
+
+def test_domain_required_anti_affinity():
+    """Required pod ANTI-affinity (one pod per zone) through the domain
+    path: the per-class cnt==0 verdict must flip as classes fill, exactly
+    like the oracle's pod_affinity_mask."""
+    nodes = [
+        _node(
+            f"n-{i}", cpu="32", pods="10",
+            labels={"topology.kubernetes.io/zone": f"z-{i % 3}"},
+        )
+        for i in range(9)
+    ]
+    tmpl = _pod(
+        "t",
+        cpu="500m",
+        labels={"app": "exc"},
+        spec_extra={
+            "affinity": {
+                "podAntiAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [
+                        {
+                            "labelSelector": {"matchLabels": {"app": "exc"}},
+                            "topologyKey": "topology.kubernetes.io/zone",
+                        }
+                    ]
+                }
+            }
+        },
+    )
+    out = _assert_domain_fires(nodes, [tmpl], [70])
+    placed = out[:70][out[:70] >= 0]
+    assert len(placed) == 3  # one per zone, anti-affinity blocks the rest
+
+
+def test_domain_required_affinity_first_pod():
+    """Required pod affinity with self-match: the first pod lands anywhere
+    (the total==0 special case), later pods must co-locate in its zone."""
+    nodes = [
+        _node(
+            f"n-{i}", cpu="32", pods="10",
+            labels={"topology.kubernetes.io/zone": f"z-{i % 3}"},
+        )
+        for i in range(9)
+    ]
+    tmpl = _pod(
+        "t",
+        cpu="500m",
+        labels={"app": "co"},
+        spec_extra={
+            "affinity": {
+                "podAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [
+                        {
+                            "labelSelector": {"matchLabels": {"app": "co"}},
+                            "topologyKey": "topology.kubernetes.io/zone",
+                        }
+                    ]
+                }
+            }
+        },
+    )
+    out = _assert_domain_fires(nodes, [tmpl], [40])
+    placed = out[:40][out[:40] >= 0]
+    zones = {int(p) % 3 for p in placed}
+    assert len(placed) == 30 and len(zones) == 1  # all in the first zone
+
+
+def test_domain_preferred_affinity_score():
+    """Preferred pod affinity through the domain path: the per-class
+    min-max-normalized score must steer pods toward the populated zone,
+    bit-identical to the oracle's score_inter_pod_affinity."""
+    nodes = [
+        _node(
+            f"n-{i}", cpu="32", pods="20",
+            labels={"topology.kubernetes.io/zone": f"z-{i % 3}"},
+        )
+        for i in range(9)
+    ]
+    tmpl = _pod(
+        "t",
+        cpu="500m",
+        labels={"app": "pref"},
+        spec_extra={
+            "affinity": {
+                "podAffinity": {
+                    "preferredDuringSchedulingIgnoredDuringExecution": [
+                        {
+                            "weight": 100,
+                            "podAffinityTerm": {
+                                "labelSelector": {
+                                    "matchLabels": {"app": "pref"}
+                                },
+                                "topologyKey": "topology.kubernetes.io/zone",
+                            },
+                        }
+                    ]
+                }
+            }
+        },
+    )
+    _assert_domain_fires(nodes, [tmpl], [60])
+
+
+def test_domain_spread_plus_affinity():
+    """Spread AND preferred affinity in one group (the full
+    partial8 + w_ipa*ipa + w_sp*sp fold) plus a second template whose
+    required anti-affinity symmetry repels the first — all through the
+    domain path, oracle-exact."""
+    nodes = [
+        _node(
+            f"n-{i}", cpu="16", pods="12",
+            labels={"topology.kubernetes.io/zone": f"z-{i % 3}"},
+        )
+        for i in range(9)
+    ]
+    both = _pod(
+        "t0",
+        cpu="500m",
+        labels={"app": "w"},
+        spec_extra={
+            "topologySpreadConstraints": [
+                {
+                    "maxSkew": 2,
+                    "topologyKey": "topology.kubernetes.io/zone",
+                    "whenUnsatisfiable": "DoNotSchedule",
+                    "labelSelector": {"matchLabels": {"app": "w"}},
+                }
+            ],
+            "affinity": {
+                "podAffinity": {
+                    "preferredDuringSchedulingIgnoredDuringExecution": [
+                        {
+                            "weight": 10,
+                            "podAffinityTerm": {
+                                "labelSelector": {"matchLabels": {"app": "w"}},
+                                "topologyKey": "topology.kubernetes.io/zone",
+                            },
+                        }
+                    ]
+                }
+            },
+        },
+    )
+    repeller = _pod(
+        "t1",
+        cpu="500m",
+        labels={"app": "lone"},
+        spec_extra={
+            "affinity": {
+                "podAntiAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [
+                        {
+                            "labelSelector": {"matchLabels": {"app": "w"}},
+                            "topologyKey": "topology.kubernetes.io/zone",
+                        }
+                    ]
+                }
+            }
+        },
+    )
+    _assert_domain_fires(nodes, [both, repeller], [50, 6])
+
+
 def test_domain_cap_falls_back_to_micro():
     """A group spanning more combined classes than DM_CAP must take the
     micro scan (the [Dc] state would not beat it), still exact."""
