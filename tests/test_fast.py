@@ -1020,6 +1020,42 @@ def test_domain_spread_plus_affinity():
     _assert_domain_fires(nodes, [both, repeller], [50, 6])
 
 
+def test_domain_pure_anti_symmetry():
+    """A group with NO constraints of its own, coupled ONLY through another
+    template's required anti-affinity (symmetry): plain pods must avoid the
+    zones holding the repeller, through the domain path."""
+    nodes = [
+        _node(
+            f"n-{i}", cpu="32", pods="10",
+            labels={"topology.kubernetes.io/zone": f"z-{i % 3}"},
+        )
+        for i in range(9)
+    ]
+    plain = _pod("t0", cpu="500m", labels={"app": "w"})
+    repeller = _pod(
+        "t1",
+        cpu="500m",
+        labels={"app": "lone"},
+        spec_extra={
+            "affinity": {
+                "podAntiAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [
+                        {
+                            "labelSelector": {"matchLabels": {"app": "w"}},
+                            "topologyKey": "topology.kubernetes.io/zone",
+                        }
+                    ]
+                }
+            }
+        },
+    )
+    out = _assert_domain_fires(nodes, [repeller, plain], [2, 40])
+    placed_plain = out[2:42][out[2:42] >= 0]
+    # the two repeller pods hold two zones; plain pods fit only in the third
+    assert len(placed_plain) == 30
+    assert len({int(p) % 3 for p in placed_plain}) == 1
+
+
 def test_domain_cap_falls_back_to_micro():
     """A group spanning more combined classes than DM_CAP must take the
     micro scan (the [Dc] state would not beat it), still exact."""
