@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import shutil
 import subprocess
-from dataclasses import dataclass
+from dataclasses import dataclass, field as dataclass_field
 from typing import List, Optional, TextIO
 
 from ..api.config import SimonConfig
@@ -86,13 +86,39 @@ def render_chart(path: str, name: str) -> List[dict]:
         return load_yaml_documents(proc.stdout)
 
 
-def build_apps(cfg: SimonConfig) -> List[AppResource]:
+@dataclass
+class FailedApp:
+    """An app whose chart/manifests could not be rendered. Rendering failures
+    degrade to a per-app failure (the remaining apps still simulate) instead
+    of aborting the whole run."""
+
+    name: str
+    error: str
+
+
+def build_apps(
+    cfg: SimonConfig, failures: Optional[List[FailedApp]] = None
+) -> List[AppResource]:
+    """Render every app in the config. With `failures` supplied, an app whose
+    chart fails to render is recorded there and skipped; without it the first
+    render error raises (backward-compatible library behavior)."""
+    import yaml as _yaml
+
     apps = []
     for app in cfg.app_list:
-        if app.chart:
-            objects = render_chart(app.path, app.name)
-        else:
-            objects = objects_from_directory(app.path)
+        try:
+            if app.chart:
+                objects = render_chart(app.path, app.name)
+            else:
+                objects = objects_from_directory(app.path)
+        except (ApplyError, _yaml.YAMLError, OSError, UnicodeDecodeError,
+                ValueError) as e:
+            if failures is None:
+                if isinstance(e, ApplyError):
+                    raise
+                raise ApplyError(f"app {app.name}: {e}")
+            failures.append(FailedApp(name=app.name, error=str(e)))
+            continue
         apps.append(AppResource(name=app.name, objects=objects))
     return apps
 
@@ -120,6 +146,7 @@ class ApplyOutcome:
     result: SimulateResult
     plan: Optional[CapacityPlan] = None
     report: str = ""
+    failed_apps: List[FailedApp] = dataclass_field(default_factory=list)
 
 
 def select_apps(
@@ -179,8 +206,14 @@ def run_apply(
     ui_out = sys.stderr if report_to_file else out
     with span("build-cluster"):
         cluster = build_cluster(cfg)
+    failed_apps: List[FailedApp] = []
     with span("render-apps"):
-        apps = build_apps(cfg)
+        apps = build_apps(cfg, failures=failed_apps)
+    if report_to_file:
+        # the report (with its FAILED APP lines) goes to --output-file, so
+        # surface render failures on the terminal too
+        for fa in failed_apps:
+            print(f"app {fa.name}: failed to render: {fa.error}", file=ui_out)
     if interactive:
         apps = select_apps(apps, ui_out, input_fn)
     new_node = load_new_node(cfg)
@@ -224,8 +257,14 @@ def run_apply(
                 result = plan.result
 
     report = full_report(result, extended_resources=extended_resources)
+    if failed_apps:
+        report += "\n" + "\n".join(
+            f"FAILED APP {fa.name}: {fa.error}" for fa in failed_apps
+        )
     print(report, file=out)
-    return ApplyOutcome(result=result, plan=plan, report=report)
+    return ApplyOutcome(
+        result=result, plan=plan, report=report, failed_apps=failed_apps
+    )
 
 
 def _interactive_loop(

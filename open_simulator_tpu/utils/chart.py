@@ -4,26 +4,39 @@ Parity target: `/root/reference/pkg/chart/chart.go` (ProcessChart →
 load → installable check → render values {Chart, Release{Name=chart name,
 Namespace=default, Revision=1, Service=Helm}, Values} → engine.Render → strip
 NOTES.txt → SortManifests by InstallOrder). The reference links Helm v3 as a
-library; this is a from-scratch renderer for the Go-template subset that
-Kubernetes application charts actually use:
+library (`vendor/helm.sh/helm/v3/pkg/engine`); this is a from-scratch
+renderer for the Go-template language as Kubernetes application charts use
+it:
 
   - {{ .path.to.value }} / {{ $.rooted.path }} lookups with `-` trim markers
-  - pipelines with the common helpers: default, quote, squote, upper, lower,
-    trim, int, toString, indent, nindent, toYaml
-  - block actions: if / else if / else / end, range (lists and dicts),
-    with / end — nested arbitrarily
+  - variables: {{ $x := expr }}, {{ $x = expr }}, {{ range $i, $v := ... }}
+  - named templates: define / include / template / block — the full
+    `helm create` scaffold (`_helpers.tpl`) renders natively
+  - pipelines with parenthesized sub-expressions and the sprig/helm helpers
+    charts actually call (printf, required, ternary, toJson, b64enc, hasKey,
+    contains, trunc, trimSuffix, replace, index, dict/list, tpl, ...)
+  - block actions: if / else if / else / end, range (lists, dicts in sorted
+    key order, ints), with / end — nested arbitrarily
   - literals: "str", 'str', `str`, ints, floats, true/false/nil
 
 Charts may be directories or .tgz archives; dependency charts under charts/
 render recursively with subchart-scoped values (values.<name> overlaid onto
-the subchart's own values, plus shared .Values.global). Templates using
-constructs outside this subset raise ChartError with the offending action —
-the apply layer falls back to a real `helm template` binary when present.
+the subchart's own values, plus shared .Values.global). Named templates share
+one namespace across the chart tree, parent definitions overriding subchart
+ones (Helm override semantics). Nondeterministic helpers (randAlphaNum,
+uuidv4, now) are intentionally unsupported — rendering is a pure function.
+Templates using constructs outside this subset raise ChartError with the
+offending action — the apply layer degrades that app to a render failure.
 """
 
 from __future__ import annotations
 
+import base64
+import hashlib
+import json
+import math
 import os
+import posixpath
 import re
 import tarfile
 import tempfile
@@ -92,7 +105,7 @@ def load_chart(path: str) -> Chart:
     try:
         return _load_chart_dir(path)
     except (OSError, UnicodeDecodeError, yaml.YAMLError) as e:
-        # surface as ChartError so render_chart's helm-binary fallback engages
+        # surface as ChartError so the apply layer records a per-app failure
         raise ChartError(f"unreadable chart {path}: {e}")
 
 
@@ -142,15 +155,25 @@ def _load_chart_dir(path: str) -> Chart:
 
 
 # ---------------------------------------------------------------------------
-# The template engine (Go text/template subset)
+# The template engine (Go text/template + the sprig subset Helm charts use)
 # ---------------------------------------------------------------------------
 
-_ACTION_RE = re.compile(r"\{\{(-?)\s*(.*?)\s*(-?)\}\}", re.DOTALL)
+# Quote-aware action lexer: a `}}` inside a string literal does not end the
+# action (Go's lexer behaves the same), so {{ tpl "{{ .x }}" . }} parses.
+# Comments are matched as an unparsed unit first — an apostrophe inside
+# {{/* don't */}} is not an open quote.
+_ACTION_RE = re.compile(
+    r"\{\{(-?)\s*("
+    r"/\*.*?\*/"
+    r"|(?:[^\"'`}]|\"(?:[^\"\\]|\\.)*\"|'(?:[^'\\]|\\.)*'|`[^`]*`|\}(?!\}))*?"
+    r")\s*(-?)\}\}",
+    re.DOTALL,
+)
 
 
 @dataclass
 class _Node:
-    kind: str                 # text | action | if | range | with
+    kind: str                 # text | action | if | range | with | define | block
     text: str = ""
     expr: str = ""
     body: list = field(default_factory=list)
@@ -234,6 +257,11 @@ def _parse(tokens, i=0, stop=()):
                 node.else_body, i, _ = block_body(i + 1, allow_else=False)
             nodes.append(node)
             i += 1
+        elif word in ("define", "block"):
+            expr = payload[len(word):].strip()
+            body, i, _ = block_body(i + 1, allow_else=False)
+            nodes.append(_Node(word, expr=expr, body=body))
+            i += 1
         elif word in ("end", "else"):
             raise ChartError(f"unexpected {{{{ {word} }}}} outside a block")
         else:
@@ -253,18 +281,81 @@ def _unescape(s: str) -> str:
     return re.sub(r"\\(.)", lambda m: _ESCAPES.get(m.group(1), m.group(1)), s)
 
 
-class _Renderer:
-    def __init__(self, root: Dict[str, Any]):
-        self.root = root
+def _literal_string(tok: str) -> str:
+    m = _STR_LIT.match(tok.strip())
+    if not m:
+        raise ChartError(f"expected a string literal, got {tok!r}")
+    s = next(g for g in m.groups() if g is not None)
+    return s if tok.strip().startswith("`") else _unescape(s)
 
-    # -- expression evaluation ---------------------------------------------
-    def _lookup(self, path: str, dot: Any) -> Any:
-        base = self.root if path.startswith("$") else dot
-        trimmed = path.lstrip("$")
-        if trimmed in ("", "."):
-            return base
-        cur = base
-        for part in trimmed.strip(".").split("."):
+
+_EXPR_TOK = re.compile(
+    r'"(?:[^"\\]|\\.)*"'      # double-quoted string
+    r"|'(?:[^'\\]|\\.)*'"     # single-quoted string
+    r"|`[^`]*`"               # raw string
+    r"|[()|]"                 # parens, pipe
+    r"|[^\s()|]+"             # atom (path, variable, number, ident)
+)
+
+
+def _tokenize_expr(expr: str) -> List[str]:
+    return _EXPR_TOK.findall(expr)
+
+
+class _Scope:
+    """Template variable scope chain. `dollar` is Go's `$`: the dot the
+    current template execution started with (not the innermost block's)."""
+
+    __slots__ = ("vars", "parent", "dollar")
+
+    def __init__(self, parent: Optional["_Scope"] = None, dollar: Any = None):
+        self.vars: Dict[str, Any] = {}
+        self.parent = parent
+        self.dollar = parent.dollar if (parent is not None and dollar is None) else dollar
+
+    def lookup(self, name: str) -> Any:
+        s: Optional[_Scope] = self
+        while s is not None:
+            if name in s.vars:
+                return s.vars[name]
+            s = s.parent
+        raise ChartError(f"undefined variable ${name}")
+
+    def declare(self, name: str, val: Any) -> None:
+        self.vars[name] = val
+
+    def assign(self, name: str, val: Any) -> None:
+        s: Optional[_Scope] = self
+        while s is not None:
+            if name in s.vars:
+                s.vars[name] = val
+                return
+            s = s.parent
+        raise ChartError(f"assignment to undeclared variable ${name}")
+
+
+_NOPIPE = object()       # sentinel: no piped-in value yet
+_MAX_TEMPLATE_DEPTH = 60    # nested include/template invocations; far past any
+                            # real chart, and low enough that the guard fires
+                            # before Python's own interpreter recursion limit
+
+
+_VAR_DECL_RE = re.compile(r"^\$([A-Za-z_]\w*)\s*(:=|=)\s*(.+)$", re.DOTALL)
+_RANGE_DECL_RE = re.compile(
+    r"^(\$[A-Za-z_]\w*)\s*(?:,\s*(\$[A-Za-z_]\w*)\s*)?:=\s*(.+)$", re.DOTALL
+)
+
+
+class _Renderer:
+    def __init__(self, templates: Optional[Dict[str, List[_Node]]] = None):
+        self.templates: Dict[str, List[_Node]] = templates if templates is not None else {}
+        self.depth = 0
+
+    # -- value lookup -------------------------------------------------------
+    def _navigate(self, cur: Any, parts: List[str]) -> Any:
+        for part in parts:
+            if not part:
+                continue
             if isinstance(cur, dict):
                 cur = cur.get(part)
             else:
@@ -273,7 +364,22 @@ class _Renderer:
                 return None
         return cur
 
-    def _atom(self, tok: str, dot: Any) -> Any:
+    def _lookup(self, path: str, dot: Any, scope: _Scope) -> Any:
+        if path.startswith("$"):
+            rest = path[1:]
+            if rest == "" or rest == ".":
+                return scope.dollar
+            if rest.startswith("."):
+                return self._navigate(scope.dollar, rest.strip(".").split("."))
+            # $name or $name.a.b
+            name, _, tail = rest.partition(".")
+            base = scope.lookup(name)
+            return self._navigate(base, tail.split(".")) if tail else base
+        if path in (".",):
+            return dot
+        return self._navigate(dot, path.strip(".").split("."))
+
+    def _atom(self, tok: str, dot: Any, scope: _Scope) -> Any:
         m = _STR_LIT.match(tok)
         if m:
             s = next(g for g in m.groups() if g is not None)
@@ -291,34 +397,142 @@ class _Renderer:
         if re.fullmatch(r"[+-]?\d*\.\d+", tok):
             return float(tok)
         if tok.startswith(".") or tok.startswith("$"):
-            return self._lookup(tok, dot)
+            return self._lookup(tok, dot, scope)
         raise ChartError(f"unsupported template expression: {tok!r}")
 
-    def _call(self, fn: str, args: List[Any]) -> Any:
+    # -- pipeline evaluation ------------------------------------------------
+    def _eval(self, expr: str, dot: Any, scope: _Scope) -> Any:
+        toks = _tokenize_expr(expr)
+        val, pos = self._pipeline(toks, 0, dot, scope)
+        if pos != len(toks):
+            raise ChartError(f"trailing tokens in expression: {expr!r}")
+        return val
+
+    def _pipeline(self, toks: List[str], i: int, dot: Any, scope: _Scope):
+        value: Any = _NOPIPE
+        while True:
+            value, i = self._command(toks, i, dot, scope, piped=value)
+            if i < len(toks) and toks[i] == "|":
+                i += 1
+                continue
+            break
+        return value, i
+
+    def _command(self, toks: List[str], i: int, dot: Any, scope: _Scope, piped: Any):
+        parts: List[Tuple[str, Any]] = []   # ("tok", str) | ("val", value)
+        while i < len(toks) and toks[i] not in ("|", ")"):
+            if toks[i] == "(":
+                v, i = self._pipeline(toks, i + 1, dot, scope)
+                if i >= len(toks) or toks[i] != ")":
+                    raise ChartError("unbalanced parentheses in expression")
+                i += 1
+                parts.append(("val", v))
+            else:
+                parts.append(("tok", toks[i]))
+                i += 1
+        if not parts:
+            if piped is not _NOPIPE:
+                return piped, i
+            raise ChartError("empty command in pipeline")
+
+        def resolve(part: Tuple[str, Any]) -> Any:
+            return part[1] if part[0] == "val" else self._atom(part[1], dot, scope)
+
+        kind, head = parts[0]
+        is_fn = (
+            kind == "tok"
+            and not head.startswith((".", "$"))
+            and not _STR_LIT.match(head)
+            and head not in ("true", "false", "nil", "null")
+            and not re.fullmatch(r"[+-]?\d+(\.\d+)?", head)
+        )
+        if is_fn:
+            args = [resolve(p) for p in parts[1:]]
+            if piped is not _NOPIPE:
+                args.append(piped)
+            return self._call(head, args, dot, scope), i
+        if len(parts) > 1:
+            # method invocation: .Capabilities.APIVersions.Has "apps/v1"
+            target = resolve(parts[0])
+            if callable(target):
+                args = [resolve(p) for p in parts[1:]]
+                if piped is not _NOPIPE:
+                    args.append(piped)
+                return target(*args), i
+            raise ChartError(
+                f"unsupported template expression: {' '.join(str(p[1]) for p in parts)!r}"
+            )
+        value = resolve(parts[0])
+        if piped is not _NOPIPE:
+            raise ChartError(f"cannot pipe into non-function {head!r}")
+        return value, i
+
+    # -- named templates ----------------------------------------------------
+    def exec_template(self, name: str, dot: Any) -> str:
+        nodes = self.templates.get(name)
+        if nodes is None:
+            raise ChartError(f"template {name!r} not defined")
+        if self.depth >= _MAX_TEMPLATE_DEPTH:
+            raise ChartError(f"template recursion too deep at {name!r}")
+        self.depth += 1
+        try:
+            # fresh scope: `$` inside a template is the dot it was called with
+            return self.render_nodes(nodes, dot, _Scope(dollar=dot))
+        finally:
+            self.depth -= 1
+
+    # -- function library ---------------------------------------------------
+    def _call(self, fn: str, args: List[Any], dot: Any, scope: _Scope) -> Any:
         if fn == "default":
             # default DEFAULT VALUE: VALUE if truthy else DEFAULT
             if len(args) != 2:
                 raise ChartError("default expects 2 arguments")
             return args[1] if _truthy(args[1]) else args[0]
         if fn == "quote":
-            return '"' + _to_string(args[0]).replace('"', '\\"') + '"'
+            return " ".join(
+                '"' + _to_string(a).replace("\\", "\\\\").replace('"', '\\"') + '"'
+                for a in args
+            )
         if fn == "squote":
-            return "'" + _to_string(args[0]) + "'"
+            return " ".join("'" + _to_string(a) + "'" for a in args)
         if fn == "upper":
             return _to_string(args[0]).upper()
         if fn == "lower":
             return _to_string(args[0]).lower()
+        if fn == "title":
+            return re.sub(
+                r"\b\w", lambda m: m.group(0).upper(), _to_string(args[0])
+            )
         if fn == "trim":
             return _to_string(args[0]).strip()
-        if fn == "int":
+        if fn == "trimAll":
+            return _to_string(args[1]).strip(_to_string(args[0]))
+        if fn == "int" or fn == "int64":
             try:
                 return int(float(args[0]))
             except (TypeError, ValueError):
                 return 0
+        if fn == "float64":
+            try:
+                return float(args[0])
+            except (TypeError, ValueError):
+                return 0.0
         if fn == "toString":
             return _to_string(args[0])
         if fn == "toYaml":
             return yaml.safe_dump(args[0], default_flow_style=False).rstrip("\n")
+        if fn == "fromYaml":
+            try:
+                return yaml.safe_load(_to_string(args[0])) or {}
+            except yaml.YAMLError:
+                return {}
+        if fn == "toJson":
+            return json.dumps(args[0], separators=(",", ":"))
+        if fn == "fromJson":
+            try:
+                return json.loads(_to_string(args[0]))
+            except (ValueError, TypeError):
+                return {}
         if fn == "indent" or fn == "nindent":
             n, s = int(args[0]), _to_string(args[1])
             pad = " " * n
@@ -327,12 +541,14 @@ class _Renderer:
         if fn == "not":
             return not _truthy(args[0])
         if fn in ("eq", "ne", "lt", "le", "gt", "ge"):
-            a, b = args[0], args[1]
+            a = args[0]
             try:
-                return {
-                    "eq": a == b, "ne": a != b, "lt": a < b,
-                    "le": a <= b, "gt": a > b, "ge": a >= b,
-                }[fn]
+                if fn == "eq":
+                    return any(a == b for b in args[1:])
+                if fn == "ne":
+                    return a != args[1]
+                b = args[1]
+                return {"lt": a < b, "le": a <= b, "gt": a > b, "ge": a >= b}[fn]
             except TypeError:
                 return False
         if fn == "and":
@@ -347,110 +563,440 @@ class _Renderer:
                 if _truthy(a):
                     return a
             return args[-1]
+        # -- sprig string helpers ------------------------------------------
+        if fn == "printf":
+            return _go_sprintf(_to_string(args[0]), args[1:])
+        if fn in ("print", "println"):
+            out = []
+            prev_str = True
+            for a in args:
+                is_str = isinstance(a, str)
+                if out and not (prev_str or is_str):
+                    out.append(" ")   # Go fmt.Sprint: space between non-strings
+                out.append(_to_string(a))
+                prev_str = is_str
+            return "".join(out) + ("\n" if fn == "println" else "")
+        if fn == "contains":
+            return _to_string(args[0]) in _to_string(args[1])
+        if fn == "hasPrefix":
+            return _to_string(args[1]).startswith(_to_string(args[0]))
+        if fn == "hasSuffix":
+            return _to_string(args[1]).endswith(_to_string(args[0]))
+        if fn == "trunc":
+            n, s = int(args[0]), _to_string(args[1])
+            return s[n:] if n < 0 else s[:n]
+        if fn == "trimSuffix":
+            suf, s = _to_string(args[0]), _to_string(args[1])
+            return s[: -len(suf)] if suf and s.endswith(suf) else s
+        if fn == "trimPrefix":
+            pre, s = _to_string(args[0]), _to_string(args[1])
+            return s[len(pre):] if pre and s.startswith(pre) else s
+        if fn == "replace":
+            old, new, s = _to_string(args[0]), _to_string(args[1]), _to_string(args[2])
+            return s.replace(old, new)
+        if fn == "repeat":
+            return _to_string(args[1]) * int(args[0])
+        if fn == "join":
+            sep = _to_string(args[0])
+            coll = args[1] if isinstance(args[1], (list, tuple)) else [args[1]]
+            return sep.join(_to_string(x) for x in coll)
+        if fn == "splitList":
+            return _to_string(args[1]).split(_to_string(args[0]))
+        if fn == "split":
+            parts = _to_string(args[1]).split(_to_string(args[0]))
+            return {f"_{i}": p for i, p in enumerate(parts)}
+        if fn == "sha256sum":
+            return hashlib.sha256(_to_string(args[0]).encode()).hexdigest()
+        if fn == "b64enc":
+            return base64.b64encode(_to_string(args[0]).encode()).decode()
+        if fn == "b64dec":
+            try:
+                return base64.b64decode(_to_string(args[0]).encode()).decode()
+            except Exception:
+                return ""
+        if fn == "kebabcase":
+            s = re.sub(r"([a-z0-9])([A-Z])", r"\1-\2", _to_string(args[0]))
+            return re.sub(r"[\s_]+", "-", s).lower()
+        if fn == "snakecase":
+            s = re.sub(r"([a-z0-9])([A-Z])", r"\1_\2", _to_string(args[0]))
+            return re.sub(r"[\s-]+", "_", s).lower()
+        if fn == "camelcase":
+            return "".join(
+                w[:1].upper() + w[1:]
+                for w in re.split(r"[\s_-]+", _to_string(args[0]))
+            )
+        # -- control / validation ------------------------------------------
+        if fn == "required":
+            # required "message" VALUE (helm: error out when value is unset)
+            if len(args) != 2:
+                raise ChartError("required expects 2 arguments")
+            if args[1] is None or args[1] == "":
+                raise ChartError(f"required value missing: {_to_string(args[0])}")
+            return args[1]
+        if fn == "fail":
+            raise ChartError(f"template fail: {_to_string(args[0])}")
+        if fn == "ternary":
+            # TRUE_VAL FALSE_VAL | ternary ... or ternary TRUE FALSE TEST
+            if len(args) != 3:
+                raise ChartError("ternary expects 3 arguments")
+            return args[0] if _truthy(args[2]) else args[1]
+        if fn == "empty":
+            return not _truthy(args[0])
+        if fn == "coalesce":
+            for a in args:
+                if _truthy(a):
+                    return a
+            return None
+        if fn == "kindOf":
+            return _go_kind(args[0])
+        if fn == "kindIs":
+            return _go_kind(args[1]) == _to_string(args[0])
+        # -- collections ----------------------------------------------------
+        if fn == "list":
+            return list(args)
+        if fn == "dict":
+            if len(args) % 2:
+                raise ChartError("dict expects an even number of arguments")
+            return {
+                _to_string(args[i]): args[i + 1] for i in range(0, len(args), 2)
+            }
+        if fn == "get":
+            d = args[0] if isinstance(args[0], dict) else {}
+            return d.get(_to_string(args[1]), "")
+        if fn == "set":
+            if not isinstance(args[0], dict):
+                raise ChartError("set expects a dict")
+            args[0][_to_string(args[1])] = args[2]
+            return args[0]
+        if fn == "unset":
+            if isinstance(args[0], dict):
+                args[0].pop(_to_string(args[1]), None)
+            return args[0]
+        if fn == "hasKey":
+            return isinstance(args[0], dict) and _to_string(args[1]) in args[0]
+        if fn == "keys":
+            out: List[str] = []
+            for a in args:
+                if isinstance(a, dict):
+                    out.extend(a.keys())
+            return sorted(out)
+        if fn == "values":
+            out = []
+            for a in args:
+                if isinstance(a, dict):
+                    out.extend(a[k] for k in sorted(a))
+            return out
+        if fn == "merge":
+            # merge DEST SRC...: later sources fill, earlier win (sprig merge)
+            out2: Dict[str, Any] = {}
+            for a in reversed(args):
+                if isinstance(a, dict):
+                    out2 = _coalesce(out2, a)
+            return out2
+        if fn == "index":
+            cur = args[0]
+            for key in args[1:]:
+                if isinstance(cur, dict):
+                    cur = cur.get(_to_string(key) if not isinstance(key, (int, float, bool)) else key)
+                elif isinstance(cur, (list, tuple, str)):
+                    try:
+                        cur = cur[int(key)]
+                    except (IndexError, ValueError, TypeError):
+                        return None
+                else:
+                    return None
+                if cur is None:
+                    return None
+            return cur
+        if fn == "first":
+            c = args[0]
+            return c[0] if isinstance(c, (list, tuple)) and c else None
+        if fn == "last":
+            c = args[0]
+            return c[-1] if isinstance(c, (list, tuple)) and c else None
+        if fn == "rest":
+            c = args[0]
+            return list(c[1:]) if isinstance(c, (list, tuple)) else []
+        if fn == "append":
+            return (list(args[0]) if isinstance(args[0], (list, tuple)) else []) + [args[1]]
+        if fn == "prepend":
+            return [args[1]] + (list(args[0]) if isinstance(args[0], (list, tuple)) else [])
+        if fn == "has":
+            coll = args[1]
+            return isinstance(coll, (list, tuple)) and args[0] in coll
+        if fn == "len":
+            try:
+                return len(args[0])
+            except TypeError:
+                return 0
+        if fn == "until":
+            return list(range(int(args[0])))
+        # -- arithmetic -----------------------------------------------------
+        if fn in ("add", "sub", "mul", "div", "mod", "max", "min", "add1"):
+            try:
+                nums = [int(a) if float(a) == int(float(a)) else float(a) for a in args]
+            except (TypeError, ValueError):
+                raise ChartError(f"{fn}: non-numeric argument")
+            if fn == "add":
+                return sum(nums)
+            if fn == "add1":
+                return nums[0] + 1
+            if fn == "sub":
+                return nums[0] - nums[1]
+            if fn == "mul":
+                out3 = 1
+                for n in nums:
+                    out3 *= n
+                return out3
+            if fn == "div":
+                return nums[0] // nums[1] if all(isinstance(n, int) for n in nums[:2]) else nums[0] / nums[1]
+            if fn == "mod":
+                return nums[0] % nums[1]
+            if fn == "max":
+                return max(nums)
+            return min(nums)
+        if fn == "floor":
+            return float(math.floor(float(args[0])))
+        if fn == "ceil":
+            return float(math.ceil(float(args[0])))
+        if fn == "round":
+            places = int(args[1]) if len(args) > 1 else 0
+            return round(float(args[0]), places)
+        # -- helm-specific --------------------------------------------------
+        if fn == "include":
+            name = _to_string(args[0])
+            data = args[1] if len(args) > 1 else None
+            return self.exec_template(name, data)
+        if fn == "tpl":
+            src = _to_string(args[0])
+            ctx = args[1] if len(args) > 1 else dot
+            toks = _tokenize_with_positions(src)
+            nodes, _, _ = _parse(toks)
+            if self.depth >= _MAX_TEMPLATE_DEPTH:
+                raise ChartError("tpl recursion too deep")
+            # Helm runs tpl against a per-invocation clone of the template
+            # set: defines inside the rendered string must not leak into
+            # (or override) the chart's own helpers.
+            sub = _Renderer(dict(self.templates))
+            _collect_defines(nodes, sub.templates)
+            sub.depth = self.depth + 1
+            return sub.render_nodes(nodes, ctx, _Scope(dollar=ctx))
+        if fn == "lookup":
+            return {}   # helm: empty when not connected to a cluster
+        if fn in ("randAlphaNum", "randAlpha", "randNumeric", "randAscii",
+                  "uuidv4", "now", "date", "genPrivateKey", "genCA",
+                  "genSelfSignedCert", "genSignedCert", "derivePassword",
+                  "htpasswd", "shuffle"):
+            raise ChartError(
+                f"nondeterministic template function {fn!r} is unsupported "
+                "(rendering is a pure function of chart + values)"
+            )
         raise ChartError(f"unsupported template function: {fn!r}")
 
-    def _eval(self, expr: str, dot: Any) -> Any:
-        expr = expr.strip()
-        if not expr:
-            return None
-        # pipeline: split on | at top level (no parens support beyond one level)
-        stages = _split_top(expr, "|")
-        value: Any = None
-        first = True
-        for stage in stages:
-            toks = _split_top(stage.strip(), " ")
-            if not toks:
-                continue
-            head = toks[0]
-            if first and (
-                head.startswith(".") or head.startswith("$") or _STR_LIT.match(head)
-                or head in ("true", "false", "nil", "null")
-                or re.fullmatch(r"[+-]?\d+(\.\d+)?", head)
-            ):
-                if len(toks) != 1:
-                    raise ChartError(f"unsupported template expression: {stage!r}")
-                value = self._atom(head, dot)
-            else:
-                args = [self._atom(t, dot) for t in toks[1:]]
-                if not first:
-                    args.append(value)
-                value = self._call(head, args)
-            first = False
-        return value
-
     # -- rendering ----------------------------------------------------------
-    def render_nodes(self, nodes: List[_Node], dot: Any) -> str:
+    def render_nodes(self, nodes: List[_Node], dot: Any, scope: _Scope) -> str:
         out: List[str] = []
         for node in nodes:
             if node.kind == "text":
                 out.append(node.text)
+            elif node.kind == "define":
+                continue   # collected at parse time (_collect_defines)
+            elif node.kind == "block":
+                toks = _tokenize_expr(node.expr)
+                if not toks:
+                    raise ChartError("block action missing a template name")
+                name = _literal_string(toks[0])
+                rest = node.expr[node.expr.index(toks[0]) + len(toks[0]):].strip()
+                arg = self._eval(rest, dot, scope) if rest else None
+                out.append(self.exec_template(name, arg))
             elif node.kind == "action":
-                word = node.expr.split(None, 1)[0] if node.expr else ""
-                if word in ("define", "template", "include", "block"):
-                    raise ChartError(
-                        f"unsupported template action: {node.expr!r}"
-                    )
-                if node.expr.startswith("/*") or word == "":
+                expr = node.expr
+                if expr.startswith("/*") or not expr:
                     continue  # comment
-                val = self._eval(node.expr, dot)
+                m = _VAR_DECL_RE.match(expr)
+                if m:
+                    name, op, rhs = m.group(1), m.group(2), m.group(3)
+                    val = self._eval(rhs, dot, scope)
+                    if op == ":=":
+                        scope.declare(name, val)
+                    else:
+                        scope.assign(name, val)
+                    continue
+                word = expr.split(None, 1)[0]
+                if word == "template":
+                    rest = expr[len("template"):].strip()
+                    toks = _tokenize_expr(rest)
+                    if not toks:
+                        raise ChartError("template action missing a name")
+                    name = _literal_string(toks[0])
+                    tail = rest[rest.index(toks[0]) + len(toks[0]):].strip()
+                    arg = self._eval(tail, dot, scope) if tail else None
+                    out.append(self.exec_template(name, arg))
+                    continue
+                val = self._eval(expr, dot, scope)
                 out.append(_to_string(val))
             elif node.kind == "if":
-                if _truthy(self._eval(node.expr, dot)):
-                    out.append(self.render_nodes(node.body, dot))
+                child = _Scope(parent=scope)
+                if _truthy(self._eval_cond(node.expr, dot, child)):
+                    out.append(self.render_nodes(node.body, dot, child))
                 else:
                     done = False
                     for cond, body in node.elifs:
-                        if _truthy(self._eval(cond, dot)):
-                            out.append(self.render_nodes(body, dot))
+                        if _truthy(self._eval_cond(cond, dot, child)):
+                            out.append(self.render_nodes(body, dot, child))
                             done = True
                             break
                     if not done and node.else_body is not None:
-                        out.append(self.render_nodes(node.else_body, dot))
+                        out.append(self.render_nodes(node.else_body, dot, child))
             elif node.kind == "range":
-                coll = self._eval(node.expr, dot)
-                items: List[Any]
-                if isinstance(coll, dict):
-                    items = [coll[k] for k in coll]
-                elif isinstance(coll, (list, tuple)):
-                    items = list(coll)
-                else:
-                    items = []
-                if items:
-                    for item in items:
-                        out.append(self.render_nodes(node.body, item))
-                elif node.else_body is not None:
-                    out.append(self.render_nodes(node.else_body, dot))
+                out.append(self._render_range(node, dot, scope))
             elif node.kind == "with":
-                val = self._eval(node.expr, dot)
+                expr = node.expr
+                var_name = None
+                m = _RANGE_DECL_RE.match(expr)
+                if m and m.group(2) is None:
+                    var_name, expr = m.group(1)[1:], m.group(3)
+                val = self._eval(expr, dot, scope)
                 if _truthy(val):
-                    out.append(self.render_nodes(node.body, val))
+                    child = _Scope(parent=scope)
+                    if var_name is not None:
+                        child.declare(var_name, val)
+                    out.append(self.render_nodes(node.body, val, child))
                 elif node.else_body is not None:
-                    out.append(self.render_nodes(node.else_body, dot))
+                    out.append(self.render_nodes(node.else_body, dot, _Scope(parent=scope)))
+        return "".join(out)
+
+    def _eval_cond(self, expr: str, dot: Any, scope: _Scope) -> Any:
+        """An if/else-if condition may declare a variable visible in the
+        block: {{ if $x := .Values.y }} (Go text/template semantics)."""
+        m = _RANGE_DECL_RE.match(expr)
+        if m and m.group(2) is None:
+            val = self._eval(m.group(3), dot, scope)
+            scope.declare(m.group(1)[1:], val)
+            return val
+        return self._eval(expr, dot, scope)
+
+    def _render_range(self, node: _Node, dot: Any, scope: _Scope) -> str:
+        expr = node.expr
+        v1 = v2 = None
+        m = _RANGE_DECL_RE.match(expr)
+        if m:
+            v1 = m.group(1)[1:]
+            v2 = m.group(2)[1:] if m.group(2) else None
+            expr = m.group(3)
+        coll = self._eval(expr, dot, scope)
+        pairs: List[Tuple[Any, Any]]   # (key-or-index, element)
+        if isinstance(coll, dict):
+            # Go templates visit maps in sorted key order
+            pairs = [(k, coll[k]) for k in sorted(coll, key=_to_string)]
+        elif isinstance(coll, (list, tuple)):
+            pairs = list(enumerate(coll))
+        elif isinstance(coll, int) and not isinstance(coll, bool):
+            pairs = [(i, i) for i in range(coll)]
+        else:
+            pairs = []
+        out: List[str] = []
+        if pairs:
+            for key, item in pairs:
+                child = _Scope(parent=scope)
+                if v1 is not None and v2 is not None:
+                    child.declare(v1, key)
+                    child.declare(v2, item)
+                elif v1 is not None:
+                    child.declare(v1, item)
+                out.append(self.render_nodes(node.body, item, child))
+        elif node.else_body is not None:
+            out.append(self.render_nodes(node.else_body, dot, _Scope(parent=scope)))
         return "".join(out)
 
 
-def _split_top(s: str, sep: str) -> List[str]:
-    """Split on sep outside quotes."""
-    parts: List[str] = []
-    cur: List[str] = []
-    quote = ""
-    for ch in s:
-        if quote:
-            cur.append(ch)
-            if ch == quote:
-                quote = ""
-        elif ch in "\"'`":
-            quote = ch
-            cur.append(ch)
-        elif ch == sep:
-            if "".join(cur).strip():
-                parts.append("".join(cur).strip())
-            cur = []
+def _collect_defines(nodes: List[_Node], registry: Dict[str, List[_Node]]) -> None:
+    """Hoist {{ define }} (and block) bodies into the shared template
+    registry; later definitions override earlier ones, which — with subcharts
+    collected before their parent — gives Helm's parent-overrides semantics."""
+    for n in nodes:
+        if n.kind in ("define", "block"):
+            toks = _tokenize_expr(n.expr)
+            if not toks:
+                raise ChartError(f"{n.kind} action missing a template name")
+            registry[_literal_string(toks[0])] = n.body
+        _collect_defines(n.body, registry)
+        for _, body in n.elifs:
+            _collect_defines(body, registry)
+        if n.else_body:
+            _collect_defines(n.else_body, registry)
+
+
+def _go_kind(v: Any) -> str:
+    if v is None:
+        return "invalid"
+    if isinstance(v, bool):
+        return "bool"
+    if isinstance(v, int):
+        return "int"
+    if isinstance(v, float):
+        return "float64"
+    if isinstance(v, str):
+        return "string"
+    if isinstance(v, (list, tuple)):
+        return "slice"
+    if isinstance(v, dict):
+        return "map"
+    return type(v).__name__
+
+
+_FMT_RE = re.compile(r"%([-+ #0]*)(\d+)?(?:\.(\d+))?([a-zA-Z%])")
+
+
+def _go_sprintf(fmt: str, args: List[Any]) -> str:
+    """Go fmt.Sprintf for the verbs charts use: %s %v %q %d %f %g %e %x %X
+    %o %b %t %c %%, with flags/width/precision."""
+    out: List[str] = []
+    pos = 0
+    ai = 0
+
+    def next_arg() -> Any:
+        nonlocal ai
+        if ai >= len(args):
+            raise ChartError(f"printf: not enough arguments for format {fmt!r}")
+        a = args[ai]
+        ai += 1
+        return a
+
+    for m in _FMT_RE.finditer(fmt):
+        out.append(fmt[pos : m.start()])
+        pos = m.end()
+        flags, width, prec, verb = m.groups()
+        if verb == "%":
+            out.append("%")
+            continue
+        spec = "%" + (flags or "") + (width or "") + (("." + prec) if prec else "")
+        a = next_arg()
+        if verb == "d":
+            out.append((spec + "d") % int(a))
+        elif verb in "oxX":
+            out.append((spec + verb) % int(a))
+        elif verb == "b":
+            out.append(format(int(a), "b"))
+        elif verb in "feEgG":
+            out.append((spec + verb) % float(a))
+        elif verb == "s":
+            out.append((spec + "s") % _to_string(a))
+        elif verb == "v":
+            out.append((spec + "s") % _to_string(a))
+        elif verb == "q":
+            out.append(
+                (spec + "s")
+                % ('"' + _to_string(a).replace("\\", "\\\\").replace('"', '\\"') + '"')
+            )
+        elif verb == "t":
+            out.append("true" if bool(a) else "false")
+        elif verb == "c":
+            out.append(chr(int(a)))
         else:
-            cur.append(ch)
-    if "".join(cur).strip():
-        parts.append("".join(cur).strip())
-    return parts
+            raise ChartError(f"printf: unsupported verb %{verb}")
+    out.append(fmt[pos:])
+    return "".join(out)
 
 
 def _truthy(v: Any) -> bool:
@@ -471,13 +1017,34 @@ def _to_string(v: Any) -> str:
         return "true"
     if v is False:
         return "false"
+    if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
+        # Go prints whole floats from template arithmetic as "1e+06"-style
+        # only at %e; default %v gives "1" for 1.0 via strconv shortest form
+        return str(int(v))
     return str(v)
 
 
+# Helper misuse (wrong arg types/counts, div-by-zero) surfaces as ChartError
+# so one bad chart degrades per-app instead of aborting the run with a
+# Python traceback.
+_RENDER_RUNTIME_ERRORS = (
+    ValueError, TypeError, ZeroDivisionError, IndexError, KeyError,
+    AttributeError, OverflowError,
+)
+
+
 def render_template(src: str, context: Dict[str, Any]) -> str:
+    """Render a standalone template string (defines inside `src` are
+    available to include/template within it)."""
     tokens = _tokenize_with_positions(src)
     nodes, _, _ = _parse(tokens)
-    return _Renderer(context).render_nodes(nodes, context)
+    registry: Dict[str, List[_Node]] = {}
+    _collect_defines(nodes, registry)
+    r = _Renderer(registry)
+    try:
+        return r.render_nodes(nodes, context, _Scope(dollar=context))
+    except _RENDER_RUNTIME_ERRORS as e:
+        raise ChartError(f"template runtime error: {e!r}")
 
 
 # ---------------------------------------------------------------------------
@@ -494,11 +1061,70 @@ def _coalesce(base: Dict[str, Any], overlay: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
-def _render_chart_files(
-    chart: Chart, values: Dict[str, Any], release_name: str
+class _APIVersions(list):
+    """`.Capabilities.APIVersions` with the `.Has` method templates call."""
+
+    def Has(self, v: Any) -> bool:   # noqa: N802 — Go method name
+        return _to_string(v) in self
+
+
+# The API surface of the vendored scheduler's Kubernetes (v1.20.5) — what the
+# reference's Helm engine would report when rendering offline.
+_CAPABILITIES: Dict[str, Any] = {
+    "KubeVersion": {
+        "Version": "v1.20.5", "GitVersion": "v1.20.5",
+        "Major": "1", "Minor": "20",
+    },
+    "APIVersions": _APIVersions([
+        "v1", "apps/v1", "batch/v1", "batch/v1beta1", "autoscaling/v1",
+        "autoscaling/v2beta2", "networking.k8s.io/v1",
+        "networking.k8s.io/v1beta1", "policy/v1beta1",
+        "rbac.authorization.k8s.io/v1", "storage.k8s.io/v1",
+        "scheduling.k8s.io/v1", "apiextensions.k8s.io/v1",
+    ]),
+    "HelmVersion": {"Version": "v3.9.4"},
+}
+
+
+def _chart_meta_ctx(metadata: Dict[str, Any]) -> Dict[str, Any]:
+    """Helm exposes Chart.yaml fields capitalized (.Chart.Name, .Chart.Version,
+    .Chart.AppVersion); keep the raw keys too for backward compatibility."""
+    ctx = dict(metadata)
+    for k, v in metadata.items():
+        if isinstance(k, str) and k:
+            ctx[k[0].upper() + k[1:]] = v
+    return ctx
+
+
+def _parse_chart_tree(
+    chart: Chart,
+    registry: Dict[str, List[_Node]],
+    parsed: List[Tuple[Chart, str, List[_Node]]],
+) -> None:
+    """Parse every template file in the chart tree, hoisting defines into the
+    shared registry. Subcharts first so parent definitions override (Helm's
+    template-override semantics), and each file also registers under its
+    chart-relative path (`mychart/templates/deployment.yaml`) so
+    `include (print $.Template.BasePath "/x.yaml") .` works."""
+    for dep in chart.dependencies:
+        _parse_chart_tree(dep, registry, parsed)
+    for rel, src in chart.templates.items():
+        tokens = _tokenize_with_positions(src)
+        nodes, _, _ = _parse(tokens)
+        _collect_defines(nodes, registry)
+        registry[posixpath.join(chart.name, rel.replace(os.sep, "/"))] = nodes
+        parsed.append((chart, rel, nodes))
+
+
+def _render_parsed(
+    chart: Chart,
+    values: Dict[str, Any],
+    release_name: str,
+    renderer: _Renderer,
+    parsed_by_chart: Dict[int, List[Tuple[str, List[_Node]]]],
 ) -> Dict[str, str]:
-    ctx = {
-        "Chart": chart.metadata,
+    ctx_base = {
+        "Chart": _chart_meta_ctx(chart.metadata),
         "Release": {
             # chart.go:27-61: the app name overwrites Chart.Metadata.Name
             # before rendering, so Release.Name is the APP name (also what
@@ -509,19 +1135,33 @@ def _render_chart_files(
             "Service": "Helm",
         },
         "Values": values,
+        "Capabilities": _CAPABILITIES,
     }
     files: Dict[str, str] = {}
-    for rel, src in chart.templates.items():
-        if rel.startswith(os.path.join("templates", "_")):
-            continue  # partials unsupported; skipped unless referenced
-        files[os.path.join(chart.name, rel)] = render_template(src, ctx)
+    for rel, nodes in parsed_by_chart.get(id(chart), []):
+        if os.path.basename(rel).startswith("_"):
+            continue  # partials: defines only, never rendered as manifests
+        tpl_name = posixpath.join(chart.name, rel.replace(os.sep, "/"))
+        ctx = dict(ctx_base)
+        ctx["Template"] = {
+            "Name": tpl_name,
+            "BasePath": posixpath.join(chart.name, "templates"),
+        }
+        try:
+            files[os.path.join(chart.name, rel)] = renderer.render_nodes(
+                nodes, ctx, _Scope(dollar=ctx)
+            )
+        except _RENDER_RUNTIME_ERRORS as e:
+            raise ChartError(f"{tpl_name}: template runtime error: {e!r}")
     # dependencies: subchart values live under values.<subchart name>,
     # sharing .Values.global and the parent's release name
     for dep in chart.dependencies:
         sub_vals = _coalesce(dep.values, values.get(dep.name) or {})
         if "global" in values:
             sub_vals = _coalesce(sub_vals, {"global": values["global"]})
-        files.update(_render_chart_files(dep, sub_vals, release_name))
+        files.update(
+            _render_parsed(dep, sub_vals, release_name, renderer, parsed_by_chart)
+        )
     return files
 
 
@@ -530,8 +1170,24 @@ def process_chart(path: str, release_name: Optional[str] = None) -> List[dict]:
     (parity: chart.ProcessChart, pkg/chart/chart.go:27-118). release_name is
     the app name from the Simon config; defaults to the chart's own name."""
     chart = load_chart(path)
-    files = _render_chart_files(
-        chart, chart.values, release_name or chart.name
+    if release_name:
+        # chart.go:23: `chartRequested.Metadata.Name = name` — the app name
+        # overwrites the top-level chart's own name BEFORE rendering, so
+        # .Chart.Name (and the scaffold helpers built on it) see the app name.
+        chart.name = release_name
+        chart.metadata = dict(chart.metadata)
+        chart.metadata["name"] = release_name
+
+    registry: Dict[str, List[_Node]] = {}
+    parsed: List[Tuple[Chart, str, List[_Node]]] = []
+    _parse_chart_tree(chart, registry, parsed)
+    parsed_by_chart: Dict[int, List[Tuple[str, List[_Node]]]] = {}
+    for ch, rel, nodes in parsed:
+        parsed_by_chart.setdefault(id(ch), []).append((rel, nodes))
+
+    renderer = _Renderer(registry)
+    files = _render_parsed(
+        chart, chart.values, release_name or chart.name, renderer, parsed_by_chart
     )
 
     docs: List[Tuple[int, int, dict]] = []  # (order, seq, object)

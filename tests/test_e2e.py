@@ -284,3 +284,47 @@ def test_server_roundtrip(cfg):
     finally:
         srv.shutdown()
         srv.server_close()
+
+
+def test_unrenderable_chart_degrades_per_app(cfg, tmp_path, monkeypatch):
+    """A chart beyond the template subset fails THAT app only — the rest of
+    the run proceeds (round-4 fix; previously aborted the whole apply).
+    The helm-binary fallback is disabled so the test is deterministic on
+    machines that do have helm installed."""
+    import open_simulator_tpu.engine.apply as apply_mod
+
+    monkeypatch.setattr(apply_mod.shutil, "which", lambda name: None)
+    from open_simulator_tpu.api.config import AppInConfig, SimonConfig
+
+    bad = tmp_path / "badchart"
+    (bad / "templates").mkdir(parents=True)
+    (bad / "Chart.yaml").write_text("name: badchart\nversion: 0.1.0\n")
+    (bad / "templates" / "cm.yaml").write_text(
+        "kind: ConfigMap\nmetadata:\n  name: {{ uuidv4 }}\n"
+    )
+    badyaml = tmp_path / "badyaml"
+    badyaml.mkdir()
+    (badyaml / "broken.yaml").write_text("metadata: [unclosed\n")
+    broken_cfg = SimonConfig(
+        custom_config=cfg.custom_config,
+        app_list=list(cfg.app_list)
+        + [
+            AppInConfig(name="bad", path=str(bad), chart=True),
+            AppInConfig(name="badyaml", path=str(badyaml)),
+        ],
+        new_node=cfg.new_node,
+    )
+    out = io.StringIO()
+    outcome = run_apply(broken_cfg, auto_plan=False, out=out)
+    # chart-render failures AND manifest-dir YAML failures both degrade
+    assert [fa.name for fa in outcome.failed_apps] == ["bad", "badyaml"]
+    assert "uuidv4" in outcome.failed_apps[0].error
+    assert "FAILED APP bad" in outcome.report
+    assert "FAILED APP badyaml" in outcome.report
+    # the good apps still simulated
+    assert sum(len(st.pods) for st in outcome.result.node_status) > 0
+    # library behavior without an accumulator still raises
+    from open_simulator_tpu.engine.apply import ApplyError, build_apps
+
+    with pytest.raises(ApplyError):
+        build_apps(broken_cfg)
