@@ -217,7 +217,9 @@ def run_apply(
     if interactive:
         apps = select_apps(apps, ui_out, input_fn)
     new_node = load_new_node(cfg)
-    profiles = load_scheduler_config(scheduler_config).profiles
+    sched_cfg = load_scheduler_config(scheduler_config)
+    profiles = sched_cfg.profiles
+    extenders = sched_cfg.extenders
     mesh = None
     if devices != 1:
         from ..parallel.mesh import product_mesh
@@ -225,7 +227,8 @@ def run_apply(
         mesh = product_mesh(devices)
 
     result = simulate(
-        cluster, apps, profiles=profiles, use_greed=use_greed, mesh=mesh
+        cluster, apps, profiles=profiles, use_greed=use_greed, mesh=mesh,
+        extenders=extenders,
     )
     plan: Optional[CapacityPlan] = None
 
@@ -234,6 +237,7 @@ def run_apply(
             result = _interactive_loop(
                 cluster, apps, new_node, result, ui_out, input_fn,
                 profiles=profiles, use_greed=use_greed, mesh=mesh,
+                extenders=extenders,
             )
         elif auto_plan:
             print(
@@ -244,7 +248,7 @@ def run_apply(
             with span("capacity-search"):
                 plan = plan_capacity(
                     cluster, apps, new_node, profiles=profiles,
-                    use_greed=use_greed, mesh=mesh,
+                    use_greed=use_greed, mesh=mesh, extenders=extenders,
                 )
             if plan is None:
                 print("capacity search failed: workload does not fit", file=out)
@@ -278,6 +282,7 @@ def _interactive_loop(
     use_greed: bool = False,
     mesh=None,
     profiles=None,
+    extenders=None,
 ) -> SimulateResult:
     """The reference's manual loop (apply.go:203-259): add one node / show
     reasons / exit, re-simulating from scratch each iteration."""
@@ -302,6 +307,6 @@ def _interactive_loop(
         )
         result = simulate(
             trial, apps, weights=weights, use_greed=use_greed, mesh=mesh,
-            profiles=profiles,
+            profiles=profiles, extenders=extenders,
         )
     return result

@@ -96,6 +96,7 @@ def _probe(
     n_pad: Optional[int] = None,
     profiles=None,
     expand_cache: Optional[dict] = None,
+    extenders=None,
 ) -> SimulateResult:
     trial = ClusterResource(
         nodes=list(cluster.nodes) + new_fake_nodes(template, k),
@@ -106,6 +107,7 @@ def _probe(
     return simulate(
         trial, apps, weights=weights, use_greed=use_greed, mesh=mesh,
         n_pad=n_pad, profiles=profiles, expand_cache=expand_cache,
+        extenders=extenders,
     )
 
 
@@ -139,6 +141,7 @@ def plan_capacity(
     use_greed: bool = False,
     mesh=None,
     profiles=None,
+    extenders=None,
 ) -> Optional[CapacityPlan]:
     """Minimum clones of `new_node` so every pod schedules and utilization
     gates pass. Returns None if even max_new_nodes doesn't suffice."""
@@ -156,7 +159,8 @@ def plan_capacity(
         return not res.unscheduled and satisfy_resource_setting(res)
 
     base = _probe(cluster, apps, new_node, 0, weights, use_greed, mesh,
-                  profiles=profiles, expand_cache=expand_cache)
+                  profiles=profiles, expand_cache=expand_cache,
+                  extenders=extenders)
     attempts += 1
     if good(base):
         return CapacityPlan(0, base, attempts)
@@ -176,7 +180,7 @@ def plan_capacity(
         # mid-probe shares the bracket's bucket)
         hi_result = _probe(
             cluster, apps, new_node, hi, weights, use_greed, mesh,
-            profiles=profiles, expand_cache=expand_cache,
+            profiles=profiles, expand_cache=expand_cache, extenders=extenders,
         )
         attempts += 1
         if good(hi_result):
@@ -193,6 +197,7 @@ def plan_capacity(
         res = _probe(
             cluster, apps, new_node, mid, weights, use_greed, mesh,
             n_pad=n_pad, profiles=profiles, expand_cache=expand_cache,
+            extenders=extenders,
         )
         attempts += 1
         last_result = res
@@ -208,6 +213,32 @@ def plan_capacity(
         best_result = _probe(
             cluster, apps, new_node, best, weights, use_greed, mesh,
             n_pad=n_pad, profiles=profiles, expand_cache=expand_cache,
+            extenders=extenders,
         )
         attempts += 1
+        # The replay's correctness rests on run-to-run determinism of
+        # simulate (e.g. DaemonSet pods re-expand with fresh RNG-suffixed
+        # names, which must never influence placement). One cheap re-check
+        # turns any future nondeterminism into a loud error instead of a
+        # silently-wrong CapacityPlan. HTTP extenders are legitimately
+        # non-reproducible (stateful endpoints, transient timeouts on
+        # ignorable extenders), so with extenders configured the mismatch is
+        # attributed and tolerated — the returned result honestly shows any
+        # unscheduled pods.
+        if not good(best_result):
+            if extenders:
+                from ..utils.tracing import log
+
+                log.warning(
+                    "capacity replay of the winning probe (%d nodes) no "
+                    "longer satisfies the plan — an extender answered "
+                    "differently between probes; returning the replayed "
+                    "result as-is", best,
+                )
+            else:
+                raise RuntimeError(
+                    "capacity replay of the winning probe no longer "
+                    f"satisfies the plan ({best} nodes): simulate() is "
+                    "nondeterministic"
+                )
     return CapacityPlan(best, best_result, attempts)

@@ -1,0 +1,213 @@
+"""Scheduler extenders: out-of-process filter/prioritize over HTTP.
+
+Parity: the vendored HTTPExtender
+(`/root/reference/vendor/k8s.io/kubernetes/pkg/scheduler/core/extender.go`)
+as wired by `pkg/simulator/simulator.go:211-216` (WithExtenders). The engine
+calls extenders between the device filter mask and the final score combine,
+exactly where `generic_scheduler.go` does:
+
+  - Filter: `findNodesThatPassExtenders` (generic_scheduler.go:345-374) —
+    extenders run in config order over the currently-feasible set; a failed
+    map entry records the node's failure message; an error skips an
+    `ignorable` extender and fails the pod otherwise.
+  - Prioritize: `prioritizeNodes` (generic_scheduler.go:521-555) — each
+    extender returns host scores in 0..10, multiplied by the extender weight,
+    summed, then scaled by MaxNodeScore/MaxExtenderPriority (= 10) and added
+    to the framework score.
+  - IsInterested (extender.go:440-468): managedResources empty = every pod;
+    otherwise the pod must request at least one managed resource.
+
+Wire format: ExtenderArgs{Pod, Nodes|NodeNames} in; ExtenderFilterResult /
+HostPriorityList out — the same JSON schema real extenders implement, so an
+extender written for the reference works against this engine unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..core.objects import Node, Pod
+from ..models.profiles import ExtenderConfig
+from ..utils.tracing import log
+
+# framework.MaxNodeScore / extenderv1.MaxExtenderPriority (100 / 10)
+EXTENDER_SCORE_SCALE = 10.0
+
+
+class ExtenderError(Exception):
+    """A non-ignorable extender failed; the pod being scheduled fails with
+    this message (the reference aborts Schedule() with the error)."""
+
+
+def _pod_json(pod: Pod) -> dict:
+    """v1.Pod JSON for the wire. Prefer the original manifest; overlay the
+    fields the engine owns (name/namespace/labels/annotations/nodeName) so
+    synthesized workload pods (whose raw is the template) are still
+    identifiable by the extender."""
+    d = dict(pod.raw) if pod.raw else {"apiVersion": "v1", "kind": "Pod"}
+    meta = dict(d.get("metadata") or {})
+    meta["name"] = pod.meta.name
+    meta["namespace"] = pod.meta.namespace or "default"
+    if pod.meta.labels:
+        meta["labels"] = dict(pod.meta.labels)
+    if pod.meta.annotations:
+        meta["annotations"] = dict(pod.meta.annotations)
+    d["metadata"] = meta
+    spec = dict(d.get("spec") or {})
+    if pod.node_name:
+        spec["nodeName"] = pod.node_name
+    spec.setdefault("schedulerName", pod.scheduler_name)
+    if not spec.get("containers"):
+        # minimal container so the pod parses as a v1.Pod on the far side
+        spec["containers"] = [
+            {
+                "name": "app",
+                "image": "none",
+                "resources": {
+                    "requests": {k: str(v) for k, v in pod.requests.items()}
+                },
+            }
+        ]
+    d["spec"] = spec
+    return d
+
+
+def _node_json(node: Node) -> dict:
+    d = dict(node.raw) if node.raw else {"apiVersion": "v1", "kind": "Node"}
+    meta = dict(d.get("metadata") or {})
+    meta["name"] = node.name
+    if node.meta.labels:
+        meta["labels"] = dict(node.meta.labels)
+    if node.meta.annotations:
+        meta["annotations"] = dict(node.meta.annotations)
+    d["metadata"] = meta
+    return d
+
+
+class HTTPExtender:
+    """One configured extender endpoint (extender.go:93-123)."""
+
+    def __init__(self, cfg: ExtenderConfig):
+        self.cfg = cfg
+        base = cfg.url_prefix.rstrip("/")
+        if cfg.enable_https and base.startswith("http://"):
+            base = "https://" + base[len("http://"):]
+        self.base = base
+        self.managed = frozenset(r for r in cfg.managed_resources if r)
+
+    # -- extender.go:440-468 ------------------------------------------------
+    def is_interested(self, pod: Pod) -> bool:
+        if not self.managed:
+            return True
+        return any(r in self.managed for r in pod.requests)
+
+    @property
+    def is_ignorable(self) -> bool:
+        return self.cfg.ignorable
+
+    def _send(self, verb: str, args: dict) -> dict:
+        url = f"{self.base}/{verb}"
+        data = json.dumps(args).encode()
+        req = urllib.request.Request(
+            url, data=data, headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(
+                req, timeout=self.cfg.http_timeout_s
+            ) as resp:
+                body = resp.read()
+                if resp.status != 200:
+                    raise ExtenderError(
+                        f"extender {url}: HTTP {resp.status}"
+                    )
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            raise ExtenderError(f"extender {url}: {e}")
+        try:
+            return json.loads(body) or {}
+        except ValueError as e:
+            raise ExtenderError(f"extender {url}: invalid JSON response: {e}")
+
+    def _wire_args(self, pod: Pod, nodes: Sequence[Node]) -> dict:
+        """ExtenderArgs{Pod, Nodes|NodeNames} — shared by filter and
+        prioritize so the wire shape can't diverge between verbs."""
+        args: dict = {"Pod": _pod_json(pod)}
+        if self.cfg.node_cache_capable:
+            args["NodeNames"] = [n.name for n in nodes]
+            args["Nodes"] = None
+        else:
+            args["NodeNames"] = None
+            args["Nodes"] = {"items": [_node_json(n) for n in nodes]}
+        return args
+
+    # -- extender.go:273-341 ------------------------------------------------
+    def filter(
+        self, pod: Pod, nodes: Sequence[Node]
+    ) -> Tuple[List[Node], Dict[str, str]]:
+        """Returns (still-feasible nodes, failed node -> message). Raises
+        ExtenderError on transport/extender errors (caller applies the
+        ignorable policy)."""
+        if not self.cfg.filter_verb:
+            return list(nodes), {}
+        by_name = {n.name: n for n in nodes}
+        result = self._send(self.cfg.filter_verb, self._wire_args(pod, nodes))
+        if result.get("Error"):
+            raise ExtenderError(
+                f"extender {self.base}: {result['Error']}"
+            )
+        out: List[Node] = []
+        if self.cfg.node_cache_capable and result.get("NodeNames") is not None:
+            for name in result["NodeNames"]:
+                node = by_name.get(name)
+                if node is None:
+                    raise ExtenderError(
+                        f"extender {self.base} claims a filtered node "
+                        f"{name!r} which is not in the input node list"
+                    )
+                out.append(node)
+        elif result.get("Nodes") is not None:
+            for item in result["Nodes"].get("items") or []:
+                name = (item.get("metadata") or {}).get("name", "")
+                node = by_name.get(name)
+                if node is not None:
+                    out.append(node)
+        failed = {
+            str(k): str(v)
+            for k, v in (result.get("FailedNodes") or {}).items()
+        }
+        return out, failed
+
+    # -- extender.go:343-381 ------------------------------------------------
+    def prioritize(
+        self, pod: Pod, nodes: Sequence[Node]
+    ) -> Dict[str, float]:
+        """host -> score*weight (HostPriorityList entries are 0..10; the
+        caller scales the combined sum by EXTENDER_SCORE_SCALE)."""
+        if not self.cfg.prioritize_verb:
+            return {}
+        result = self._send(self.cfg.prioritize_verb, self._wire_args(pod, nodes))
+        out: Dict[str, float] = {}
+        entries = result if isinstance(result, list) else []
+        for item in entries:
+            if isinstance(item, dict):
+                out[str(item.get("Host", ""))] = (
+                    float(item.get("Score", 0)) * float(self.cfg.weight)
+                )
+        return out
+
+
+def build_extenders(
+    configs: Optional[Sequence[ExtenderConfig]],
+) -> List[HTTPExtender]:
+    exts = [HTTPExtender(c) for c in (configs or [])]
+    for e in exts:
+        if e.cfg.preempt_verb or e.cfg.bind_verb:
+            log.warning(
+                "extender %s: preemptVerb/bindVerb are accepted but inert "
+                "(simon disables DefaultBinder; the engine's preemption pass "
+                "has no extender hook)", e.base,
+            )
+    return exts

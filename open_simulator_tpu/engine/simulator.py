@@ -167,6 +167,7 @@ class Simulator:
         plugins=None,
         patch_pods=None,
         expand_cache=None,
+        extenders=None,
     ) -> None:
         """`mesh` (jax.sharding.Mesh or None): when set, the node axis of the
         cluster state is sharded across the mesh devices and the same grouped
@@ -193,6 +194,12 @@ class Simulator:
         from ..plugins import split_registry
 
         self._extra_filters, self._extra_scores = split_registry(plugins or ())
+        # Scheduler extenders (WithExtenders parity, simulator.go:211-216):
+        # config-global HTTP filter/prioritize callbacks. Non-empty extenders
+        # switch scheduling to the per-pod probe→extend→commit path.
+        from .extenders import build_extenders
+
+        self._extenders = build_extenders(extenders)
         # Per-workload-kind pod mutation hooks (WithPatchPodsFuncMap parity,
         # simulator.go:243-249,471-500): kind -> fn(List[Pod]) applied to
         # every pod list generated from that workload kind.
@@ -344,6 +351,39 @@ class Simulator:
         """Encode one profile run, scan it on device, decode placements."""
         if not pods:
             return []
+        if self._extenders:
+            # Only pods some extender is interested in pay the per-pod HTTP
+            # path; consecutive uninterested runs keep the fused batch scan.
+            # Splitting by CONSECUTIVE runs preserves the exact sequential-
+            # commit order across the whole batch.
+            failed: List[UnscheduledPod] = []
+            i = 0
+            while i < len(pods):
+                j = i
+                interested = any(
+                    e.is_interested(pods[i]) for e in self._extenders
+                )
+                while j < len(pods) and interested == any(
+                    e.is_interested(pods[j]) for e in self._extenders
+                ):
+                    j += 1
+                if interested:
+                    failed.extend(
+                        self._schedule_run_extenders(
+                            pods[i:j], weights, filter_on
+                        )
+                    )
+                else:
+                    failed.extend(
+                        self._schedule_run_batch(pods[i:j], weights, filter_on)
+                    )
+                i = j
+            return failed
+        return self._schedule_run_batch(pods, weights, filter_on)
+
+    def _schedule_run_batch(
+        self, pods: List[Pod], weights, filter_on
+    ) -> List[UnscheduledPod]:
         with span("encode", pods=len(pods)):
             batch = encode_pods(self.enc, pods)
         carry0, ns0 = self._carry, self._ns
@@ -380,32 +420,189 @@ class Simulator:
         for i, pod in enumerate(pods):
             ni = int(placed_np[i])
             if ni >= 0:
-                pod.node_name = self._table.names[ni]
-                pod.phase = "Running"
-                if pod.gpu_mem_request() > 0:
-                    # Device ids in allocation order, duplicates = multiple
-                    # shares packed onto one device (parity: the gpu-index
-                    # annotation codec, utils/pod.go:102-116).
-                    ids = [
-                        str(d)
-                        for d in range(take_np.shape[1])
-                        for _ in range(int(take_np[i, d]))
-                    ]
-                    if ids:
-                        pod.meta.annotations[ANNO_GPU_INDEX] = "-".join(ids)
-                if vg_np[i].any() or dev_np[i].any():
-                    # Remember which VG slots / devices this pod took so an
-                    # eviction can reverse the allocation exactly.
-                    self._storage_takes[pod.key] = (
-                        vg_np[i].copy(),
-                        dev_np[i].copy(),
-                    )
-                self._bound.append((pod, pod.node_name))
+                self._bind_placed(pod, ni, take_np[i], vg_np[i], dev_np[i])
             else:
                 failed.append(
                     UnscheduledPod(pod, _reason_string(n_nodes, reasons_np[i]))
                 )
         return failed
+
+    def _bind_placed(self, pod: Pod, ni: int, take_row, vg_row, dev_row) -> None:
+        """Record one placement on the host side (pod fields, bound list,
+        storage reversal info) — shared by the batch decode and the extender
+        per-pod path."""
+        pod.node_name = self._table.names[ni]
+        pod.phase = "Running"
+        if pod.gpu_mem_request() > 0:
+            # Device ids in allocation order, duplicates = multiple
+            # shares packed onto one device (parity: the gpu-index
+            # annotation codec, utils/pod.go:102-116).
+            ids = [
+                str(d)
+                for d in range(take_row.shape[0])
+                for _ in range(int(take_row[d]))
+            ]
+            if ids:
+                pod.meta.annotations[ANNO_GPU_INDEX] = "-".join(ids)
+        if vg_row.any() or dev_row.any():
+            # Remember which VG slots / devices this pod took so an
+            # eviction can reverse the allocation exactly.
+            self._storage_takes[pod.key] = (
+                np.asarray(vg_row).copy(),
+                np.asarray(dev_row).copy(),
+            )
+        self._bound.append((pod, pod.node_name))
+
+    def _schedule_run_extenders(
+        self, pods: List[Pod], weights, filter_on
+    ) -> List[UnscheduledPod]:
+        """Per-pod scheduling with extenders folded in (the split point
+        generic_scheduler.go sits at: device filters → extender Filter chain
+        (findNodesThatPassExtenders, :345-374) → device scores + extender
+        Prioritize × weight × MaxNodeScore/MaxExtenderPriority (:521-555) →
+        argmax → device commit). One probe + one commit device call per pod —
+        the HTTP round trip dominates either way, exactly as it does in the
+        reference."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.kernels import commit_step, probe_step
+        from ..ops.state import pod_rows_from_batch
+        from ..utils.tracing import log
+        from .extenders import EXTENDER_SCORE_SCALE, ExtenderError
+
+        with span("encode", pods=len(pods)):
+            batch = encode_pods(self.enc, pods)
+            rows = pod_rows_from_batch(batch)
+        fo = None if filter_on is None else jnp.asarray(filter_on)
+        failed: List[UnscheduledPod] = []
+        n_nodes = len(self.cluster.nodes)
+        scheduled = 0
+        with span("schedule-extenders", pods=len(pods)) as sp:
+            for i, pod in enumerate(pods):
+                row = jax.tree.map(lambda a: a[i], rows)
+                mask, score, first_fail = probe_step(
+                    self._ns, self._carry, row, weights, fo,
+                    self._extra_filters, self._extra_scores,
+                )
+                mask_np = np.asarray(mask)
+                score_np = np.asarray(score)
+                ff_np = np.asarray(first_fail)
+                feasible = [
+                    self.cluster.nodes[j] for j in range(n_nodes) if mask_np[j]
+                ]
+                n_device_feasible = len(feasible)
+                ext_msgs: Dict[str, str] = {}   # node -> extender failure msg
+                error: Optional[str] = None
+                for ext in self._extenders:
+                    if not feasible:
+                        break
+                    if not ext.is_interested(pod):
+                        continue
+                    try:
+                        feasible, failed_map = ext.filter(pod, feasible)
+                    except ExtenderError as e:
+                        if ext.is_ignorable:
+                            log.warning(
+                                "skipping ignorable extender: %s", e
+                            )
+                            continue
+                        error = str(e)
+                        break
+                    for name, msg in failed_map.items():
+                        ext_msgs.setdefault(name, msg)
+                if error is not None:
+                    failed.append(UnscheduledPod(pod, error))
+                    continue
+                if not feasible:
+                    failed.append(
+                        UnscheduledPod(
+                            pod,
+                            self._extender_reason(
+                                n_nodes, mask_np, ff_np, ext_msgs,
+                                n_device_feasible,
+                            ),
+                        )
+                    )
+                    continue
+                combined = {n.name: 0.0 for n in feasible}
+                for ext in self._extenders:
+                    if not ext.cfg.prioritize_verb or not ext.is_interested(pod):
+                        continue
+                    try:
+                        for host, s in ext.prioritize(pod, feasible).items():
+                            if host in combined:
+                                combined[host] += s
+                    except ExtenderError as e:
+                        # prioritize errors are ignored (generic_scheduler.go
+                        # :529-536 logs and drops them)
+                        log.warning("extender prioritize failed: %s", e)
+                # lowest-node-index tie-break, matching the scan's argmax
+                name_index = self._name_index_map()
+                best_ni, best_total = -1, -np.inf
+                for j in sorted(name_index[n.name] for n in feasible):
+                    total = float(score_np[j]) + (
+                        combined[self.cluster.nodes[j].name]
+                        * EXTENDER_SCORE_SCALE
+                    )
+                    if total > best_total:
+                        best_ni, best_total = j, total
+                self._carry, take, vg_take, dev_take = commit_step(
+                    self._ns, self._carry, row, jnp.int32(best_ni)
+                )
+                self._bind_placed(
+                    pod, best_ni, np.asarray(take), np.asarray(vg_take),
+                    np.asarray(dev_take),
+                )
+                scheduled += 1
+            sp.meta["scheduled"] = scheduled
+        progress(
+            "scheduled batch (extenders): %d/%d pods placed in %.2fs",
+            scheduled, len(pods), sp.duration,
+        )
+        return failed
+
+    def _name_index_map(self) -> Dict[str, int]:
+        if not hasattr(self, "_name_index"):
+            self._name_index = {
+                name: i for i, name in enumerate(self._table.names)
+            }
+        return self._name_index
+
+    @staticmethod
+    def _extender_reason(
+        n_nodes: int,
+        mask_np: np.ndarray,
+        ff_np: np.ndarray,
+        ext_msgs: Dict[str, str],
+        n_device_feasible: int,
+    ) -> str:
+        """Reason string when the extender chain empties the feasible set:
+        device per-filter counts for device-failed nodes + extender failedMap
+        messages; nodes an extender dropped without a message get the generic
+        'didn't pass extender filter' count (the reference leaves those out of
+        the FitError entirely — strictly less informative, so we deviate)."""
+        counts = np.zeros(NUM_FILTERS, np.int64)
+        for j in range(min(n_nodes, mask_np.shape[0])):
+            if not mask_np[j] and ff_np[j] < NUM_FILTERS:
+                counts[ff_np[j]] += 1
+        parts = [
+            f"{int(counts[f])} {FILTER_MESSAGES[f]}"
+            for f in range(NUM_FILTERS)
+            if counts[f] > 0
+        ]
+        by_msg: Dict[str, int] = {}
+        for msg in ext_msgs.values():
+            by_msg[msg] = by_msg.get(msg, 0) + 1
+        for msg in sorted(by_msg):
+            parts.append(f"{by_msg[msg]} node(s) {msg}")
+        unexplained = n_device_feasible - len(ext_msgs)
+        if unexplained > 0:
+            parts.append(
+                f"{unexplained} node(s) didn't pass extender filter"
+            )
+        detail = ", ".join(parts) if parts else "no nodes in cluster"
+        return f"0/{n_nodes} nodes are available: {detail}."
 
     # -- preemption (PostFilter) -------------------------------------------
     def _device_fits(self, bound_by_node):
@@ -808,6 +1005,7 @@ def simulate(
     plugins=None,
     patch_pods=None,
     expand_cache=None,
+    extenders=None,
 ) -> SimulateResult:
     """One-shot simulation (parity: simulator.Simulate, core.go:67-119).
 
@@ -815,9 +1013,11 @@ def simulate(
     `patch_pods`: {workload kind: fn(List[Pod])} mutation hooks applied to
     generated pods (WithPatchPodsFuncMap parity).
     `expand_cache`: see Simulator — share one dict across re-simulations of
-    the same apps (capacity search) to expand/validate workloads once."""
+    the same apps (capacity search) to expand/validate workloads once.
+    `extenders`: ExtenderConfig list (models/profiles.py) — HTTP
+    filter/prioritize callbacks (WithExtenders parity)."""
     return Simulator(
         cluster, weights=weights, use_greed=use_greed, mesh=mesh, n_pad=n_pad,
         profiles=profiles, plugins=plugins, patch_pods=patch_pods,
-        expand_cache=expand_cache,
+        expand_cache=expand_cache, extenders=extenders,
     ).run(apps)

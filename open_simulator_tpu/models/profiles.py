@@ -7,8 +7,9 @@ disabled, PercentageOfNodesToScore pinned to 100
 user-supplied scheduler config file (`--default-scheduler-config`,
 `cmd/apply/apply.go:28`), then hands every profile to scheduler.New
 (`simulator.go:204-216`, WithProfiles...). Extenders in the user config are
-wired by the reference (WithExtenders) but unsupported here — rejected with an
-explicit error instead of silently dropped.
+wired the way the reference does (WithExtenders, simulator.go:215): parsed
+into ExtenderConfig entries that the engine calls over HTTP between the
+device filter mask and the score combine (engine/extenders.py).
 
 A profile carries (a) the weight vector for the score kernels, (b) a
 bool[NUM_FILTERS] filter-enable mask honoring the config's Filter
@@ -93,13 +94,70 @@ class SchedulerProfile:
 
 
 @dataclass
+class ExtenderConfig:
+    """One `extenders:` entry of a KubeSchedulerConfiguration (parity:
+    vendored KubeSchedulerConfiguration.Extenders → HTTPExtender,
+    vendor/.../scheduler/core/extender.go:93-123). preemptVerb/bindVerb are
+    accepted but inert: simon disables DefaultBinder and binds through its own
+    plugin, and the engine's preemption pass has no extender hook."""
+
+    url_prefix: str = ""
+    filter_verb: str = ""
+    prioritize_verb: str = ""
+    preempt_verb: str = ""
+    bind_verb: str = ""
+    weight: int = 1
+    enable_https: bool = False
+    http_timeout_s: float = 30.0
+    node_cache_capable: bool = False
+    # resource names; empty = interested in every pod (extender.go:442-445)
+    managed_resources: List[str] = field(default_factory=list)
+    ignorable: bool = False
+
+    @staticmethod
+    def from_dict(d: dict) -> "ExtenderConfig":
+        timeout = d.get("httpTimeout")
+        seconds = 30.0
+        if isinstance(timeout, (int, float)):
+            seconds = float(timeout)
+        elif isinstance(timeout, str) and timeout:
+            # metav1.Duration strings: "5s", "300ms", "1m"
+            import re as _re
+
+            m = _re.fullmatch(r"([\d.]+)(ms|s|m|h)", timeout.strip())
+            if m:
+                mult = {"ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0}[m.group(2)]
+                seconds = float(m.group(1)) * mult
+        return ExtenderConfig(
+            url_prefix=d.get("urlPrefix", "") or "",
+            filter_verb=d.get("filterVerb", "") or "",
+            prioritize_verb=d.get("prioritizeVerb", "") or "",
+            preempt_verb=d.get("preemptVerb", "") or "",
+            bind_verb=d.get("bindVerb", "") or "",
+            weight=int(d.get("weight") or 1),
+            enable_https=bool(d.get("enableHTTPS")),
+            http_timeout_s=seconds,
+            node_cache_capable=bool(d.get("nodeCacheCapable")),
+            managed_resources=[
+                r.get("name", "")
+                for r in (d.get("managedResources") or [])
+                if isinstance(r, dict)
+            ],
+            ignorable=bool(d.get("ignorable")),
+        )
+
+
+@dataclass
 class SchedulerConfig:
     """All profiles of one KubeSchedulerConfiguration, keyed by scheduler
     name. profiles[0] is the default profile (the reference forces
-    Profiles[0].SchedulerName = default-scheduler, utils.go:318)."""
+    Profiles[0].SchedulerName = default-scheduler, utils.go:318).
+    `extenders` is config-global (shared by every profile), exactly like
+    ComponentConfig.Extenders in the reference."""
     profiles: List[SchedulerProfile] = field(
         default_factory=lambda: [SchedulerProfile()]
     )
+    extenders: List[ExtenderConfig] = field(default_factory=list)
 
     @property
     def default(self) -> SchedulerProfile:
@@ -161,7 +219,9 @@ def load_scheduler_config(path: Optional[str]) -> SchedulerConfig:
     enable/disable adjusts weights, filter enable/disable flips the filter
     mask, multiple profiles are kept keyed by schedulerName; simon's own
     plugins stay enabled regardless (the reference injects them after
-    merging). Extenders raise — the engine has no extender transport."""
+    merging). Extenders parse into ExtenderConfig entries; a filter-less,
+    prioritize-less extender (bind/preempt only) is rejected since nothing
+    would ever call it."""
     cfg = SchedulerConfig()
     if not path:
         return cfg
@@ -170,12 +230,16 @@ def load_scheduler_config(path: Optional[str]) -> SchedulerConfig:
     kind = doc.get("kind", "")
     if kind and kind != "KubeSchedulerConfiguration":
         raise ValueError(f"{path}: expected KubeSchedulerConfiguration, got {kind}")
-    if doc.get("extenders"):
-        raise ValueError(
-            f"{path}: scheduler extenders are not supported by the TPU engine "
-            "(the reference wires them via HTTP, simulator.go:216; implement "
-            "the scoring as a plugin instead)"
-        )
+    for e in doc.get("extenders") or []:
+        ext = ExtenderConfig.from_dict(e or {})
+        if not ext.url_prefix:
+            raise ValueError(f"{path}: extender missing urlPrefix")
+        if not ext.filter_verb and not ext.prioritize_verb:
+            raise ValueError(
+                f"{path}: extender {ext.url_prefix}: neither filterVerb nor "
+                "prioritizeVerb set — nothing for the engine to call"
+            )
+        cfg.extenders.append(ext)
     profiles = doc.get("profiles") or [{}]
     names = [
         (p or {}).get("schedulerName", "default-scheduler") for p in profiles

@@ -980,6 +980,32 @@ def run_scores(
 # The scan: sequential commit of a pod batch in one device computation
 # ---------------------------------------------------------------------------
 
+def commit_onehot(ns: NodeStatic, carry: Carry, pod: PodRow, onehot):
+    """Apply one pod's placement (onehot bool[N], all-False = no commit) to
+    the carry. The single commit implementation shared by the naive scan and
+    the extender per-pod path — placements must mutate state identically on
+    both."""
+    free = carry.free - onehot[:, None] * pod.req[None, :]
+    sel_counts = carry.sel_counts + (
+        pod.match_sel.astype(jnp.float32)[:, None] * onehot.astype(jnp.float32)[None, :]
+    )
+    gpu_take, gpu_free = gpu_allocate(ns, carry, pod, onehot)
+    vg_free, dev_free, vg_take, dev_take = local_storage_commit(
+        ns, carry, pod, onehot
+    )
+    port_any, port_wild, port_ipc = ports_commit(carry, pod, onehot)
+    anti_counts = carry.anti_counts + (
+        pod.own_anti[:, None] * onehot.astype(jnp.float32)[None, :]
+    )
+    new_carry = Carry(
+        free=free, sel_counts=sel_counts, gpu_free=gpu_free,
+        vg_free=vg_free, dev_free=dev_free,
+        port_any=port_any, port_wild=port_wild, port_ipc=port_ipc,
+        anti_counts=anti_counts,
+    )
+    return new_carry, gpu_take, vg_take, dev_take
+
+
 def schedule_step(
     ns: NodeStatic,
     weights: jnp.ndarray,
@@ -997,17 +1023,8 @@ def schedule_step(
     node_out = jnp.where(ok, node, -1)
 
     onehot = (jnp.arange(ns.valid.shape[0]) == node) & ok
-    free = carry.free - onehot[:, None] * pod.req[None, :]
-    sel_counts = carry.sel_counts + (
-        pod.match_sel.astype(jnp.float32)[:, None] * onehot.astype(jnp.float32)[None, :]
-    )
-    gpu_take, gpu_free = gpu_allocate(ns, carry, pod, onehot)
-    vg_free, dev_free, vg_take, dev_take = local_storage_commit(
+    new_carry, gpu_take, vg_take, dev_take = commit_onehot(
         ns, carry, pod, onehot
-    )
-    port_any, port_wild, port_ipc = ports_commit(carry, pod, onehot)
-    anti_counts = carry.anti_counts + (
-        pod.own_anti[:, None] * onehot.astype(jnp.float32)[None, :]
     )
 
     reason_counts = jnp.zeros(NUM_FILTERS, jnp.int32).at[
@@ -1015,12 +1032,6 @@ def schedule_step(
     ].add(jnp.where((first_fail < NUM_FILTERS) & ns.valid, 1, 0))
     reason_counts = jnp.where(ok, jnp.zeros_like(reason_counts), reason_counts)
 
-    new_carry = Carry(
-        free=free, sel_counts=sel_counts, gpu_free=gpu_free,
-        vg_free=vg_free, dev_free=dev_free,
-        port_any=port_any, port_wild=port_wild, port_ipc=port_ipc,
-        anti_counts=anti_counts,
-    )
     return new_carry, (
         node_out.astype(jnp.int32),
         reason_counts,
@@ -1028,6 +1039,39 @@ def schedule_step(
         vg_take,
         dev_take,
     )
+
+
+@functools.partial(jax.jit, static_argnames=("extra_filters", "extra_scores"))
+def probe_step(
+    ns: NodeStatic,
+    carry: Carry,
+    pod: PodRow,
+    weights: jnp.ndarray,
+    filter_on=None,
+    extra_filters=(),
+    extra_scores=(),
+):
+    """Filter + score ONE pod without committing: (mask bool[N], score f32[N]
+    with -inf on infeasible nodes, first_fail i32[N]). The extender path pulls
+    these to the host, folds in extender filter/prioritize results, then
+    commits via commit_step — the split point generic_scheduler.go sits at
+    between findNodesThatPassExtenders (:263) and prioritizeNodes (:521)."""
+    mask, first_fail = run_filters(ns, carry, pod, filter_on, extra_filters)
+    score = run_scores(ns, carry, pod, weights, extra_scores)
+    score = jnp.where(mask, score, -jnp.inf)
+    return mask & ns.valid, score, first_fail
+
+
+@jax.jit
+def commit_step(ns: NodeStatic, carry: Carry, pod: PodRow, node):
+    """Commit ONE pod to node index `node` (i32 scalar; -1 = no commit).
+    Same state transition as the scan's schedule_step for the same choice."""
+    ok = (node >= 0) & pod.valid
+    onehot = (jnp.arange(ns.valid.shape[0]) == node) & ok
+    new_carry, gpu_take, vg_take, dev_take = commit_onehot(
+        ns, carry, pod, onehot
+    )
+    return new_carry, gpu_take.astype(jnp.int32), vg_take, dev_take
 
 
 @functools.partial(jax.jit, static_argnames=("extra_filters", "extra_scores"))

@@ -359,7 +359,18 @@ class _Renderer:
             if isinstance(cur, dict):
                 cur = cur.get(part)
             else:
-                cur = getattr(cur, part, None)
+                # Attribute access is restricted to the template-safe method
+                # surface (e.g. APIVersions.Has) — field access on a scalar is
+                # an error in Go templates, and an open getattr would leak
+                # Python internals ({{ .Values.x.__class__ }}) into manifests.
+                safe = getattr(type(cur), "__template_safe__", ())
+                if part in safe:
+                    cur = getattr(cur, part)
+                else:
+                    raise ChartError(
+                        f"cannot access field {part!r} on "
+                        f"{_go_kind(cur)} value"
+                    )
             if cur is None:
                 return None
         return cur
@@ -749,9 +760,23 @@ class _Renderer:
                     out3 *= n
                 return out3
             if fn == "div":
-                return nums[0] // nums[1] if all(isinstance(n, int) for n in nums[:2]) else nums[0] / nums[1]
+                if all(isinstance(n, int) for n in nums[:2]):
+                    # Go int64 division truncates toward zero (-7/2 = -3),
+                    # Python's // floors (-4) — correct the sign case
+                    q = nums[0] // nums[1]
+                    if q < 0 and q * nums[1] != nums[0]:
+                        q += 1
+                    return q
+                return nums[0] / nums[1]
             if fn == "mod":
-                return nums[0] % nums[1]
+                if all(isinstance(n, int) for n in nums[:2]):
+                    # Go % takes the dividend's sign (-7%2 = -1); derive from
+                    # the truncated quotient (exact for big ints, no floats)
+                    q = nums[0] // nums[1]
+                    if q < 0 and q * nums[1] != nums[0]:
+                        q += 1
+                    return nums[0] - nums[1] * q
+                return math.fmod(nums[0], nums[1])
             if fn == "max":
                 return max(nums)
             return min(nums)
@@ -1063,6 +1088,8 @@ def _coalesce(base: Dict[str, Any], overlay: Dict[str, Any]) -> Dict[str, Any]:
 
 class _APIVersions(list):
     """`.Capabilities.APIVersions` with the `.Has` method templates call."""
+
+    __template_safe__ = ("Has",)
 
     def Has(self, v: Any) -> bool:   # noqa: N802 — Go method name
         return _to_string(v) in self

@@ -447,3 +447,23 @@ def test_helper_misuse_raises_chart_error():
     ):
         with pytest.raises(ChartError):
             render_template(src, CTX)
+
+
+def test_scalar_field_access_is_an_error():
+    # Go templates error on field access through a scalar; an open getattr
+    # would leak Python internals into manifests
+    with pytest.raises(ChartError, match="cannot access field"):
+        render_template("{{ .Values.name.upper }}", CTX)
+    with pytest.raises(ChartError, match="cannot access field"):
+        render_template("{{ .Values.name.__class__ }}", CTX)
+    # navigation through a missing key still renders empty (kube charts
+    # lean on this)
+    assert render_template("{{ .Values.missing.deeper }}", CTX) == ""
+
+
+def test_div_mod_truncate_toward_zero():
+    # Go int64 semantics: -7/2 = -3, -7%2 = -1 (Python floors: -4 / 1)
+    assert render_template("{{ div -7 2 }}", CTX) == "-3"
+    assert render_template("{{ mod -7 2 }}", CTX) == "-1"
+    assert render_template("{{ div 7 2 }}", CTX) == "3"
+    assert render_template("{{ mod 7 -2 }}", CTX) == "1"

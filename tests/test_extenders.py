@@ -1,0 +1,335 @@
+"""Scheduler extenders: HTTP filter/prioritize folded between the device
+mask and the score combine.
+
+Parity targets: scheduler.WithExtenders wiring (simulator.go:211-216), the
+vendored HTTPExtender (core/extender.go: Filter :273, Prioritize :343,
+IsInterested :440), findNodesThatPassExtenders (generic_scheduler.go:345-374)
+and the extender score fold (generic_scheduler.go:521-555, × weight ×
+MaxNodeScore/MaxExtenderPriority).
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from open_simulator_tpu.core.objects import Node
+from open_simulator_tpu.engine.simulator import (
+    AppResource,
+    ClusterResource,
+    simulate,
+)
+from open_simulator_tpu.models.profiles import ExtenderConfig, load_scheduler_config
+
+
+def _nodes(n, cpu="16"):
+    return [
+        Node.from_dict(
+            {
+                "metadata": {
+                    "name": f"n{i}",
+                    "labels": {"kubernetes.io/hostname": f"n{i}"},
+                },
+                "status": {
+                    "allocatable": {"cpu": cpu, "memory": "32Gi", "pods": "110"}
+                },
+            }
+        )
+        for i in range(n)
+    ]
+
+
+def _deploy(replicas=1, cpu="1", name="d"):
+    return {
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": "x"},
+        "spec": {
+            "replicas": replicas,
+            "template": {
+                "metadata": {"labels": {"app": name}},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "c",
+                            "image": "img",
+                            "resources": {"requests": {"cpu": cpu}},
+                        }
+                    ]
+                },
+            },
+        },
+    }
+
+
+class _StubExtender:
+    """In-process extender endpoint. `behavior` is a dict:
+    - allow: set of node names the filter keeps (None = keep all)
+    - failed: {node: msg} map returned as FailedNodes
+    - scores: {node: int 0..10} returned by prioritize
+    - error: string returned as ExtenderFilterResult.Error
+    - http_error: int -> respond with that status code
+    Records every request body in .calls."""
+
+    def __init__(self, behavior):
+        self.behavior = behavior
+        self.calls = []
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                stub.calls.append((self.path, body))
+                if stub.behavior.get("http_error"):
+                    self.send_response(stub.behavior["http_error"])
+                    self.end_headers()
+                    return
+                if self.path.endswith("/filter"):
+                    names = body.get("NodeNames")
+                    if names is None:
+                        names = [
+                            (i.get("metadata") or {}).get("name")
+                            for i in (body.get("Nodes") or {}).get("items") or []
+                        ]
+                    allow = stub.behavior.get("allow")
+                    failed = stub.behavior.get("failed") or {}
+                    keep = [
+                        n for n in names
+                        if (allow is None or n in allow) and n not in failed
+                    ]
+                    if body.get("NodeNames") is not None:
+                        resp = {
+                            "NodeNames": keep,
+                            "FailedNodes": failed,
+                            "Error": stub.behavior.get("error", ""),
+                        }
+                    else:
+                        resp = {
+                            "Nodes": {
+                                "items": [
+                                    {"metadata": {"name": n}} for n in keep
+                                ]
+                            },
+                            "FailedNodes": failed,
+                            "Error": stub.behavior.get("error", ""),
+                        }
+                else:  # prioritize
+                    names = body.get("NodeNames")
+                    if names is None:
+                        names = [
+                            (i.get("metadata") or {}).get("name")
+                            for i in (body.get("Nodes") or {}).get("items") or []
+                        ]
+                    scores = stub.behavior.get("scores") or {}
+                    resp = [
+                        {"Host": n, "Score": int(scores.get(n, 0))}
+                        for n in names
+                    ]
+                out = json.dumps(resp).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+        self.server = HTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self.thread.start()
+        self.url = f"http://127.0.0.1:{self.server.server_address[1]}/ext"
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture
+def stub_factory():
+    stubs = []
+
+    def make(behavior):
+        s = _StubExtender(behavior)
+        stubs.append(s)
+        return s
+
+    yield make
+    for s in stubs:
+        s.close()
+
+
+def _ext(url, **kw):
+    return ExtenderConfig(
+        url_prefix=url, filter_verb="filter", prioritize_verb="prioritize",
+        **kw,
+    )
+
+
+def test_filter_changes_placement(stub_factory):
+    # without the extender the pod balances onto any node; the extender pins
+    # everything to n3
+    stub = stub_factory({"allow": {"n3"}})
+    cluster = ClusterResource(nodes=_nodes(5))
+    apps = [AppResource(name="a", objects=[_deploy(replicas=3)])]
+    res = simulate(cluster, apps, extenders=[_ext(stub.url)])
+    assert not res.unscheduled
+    placed = {
+        p.meta.name: st.node.name
+        for st in res.node_status
+        for p in st.pods
+    }
+    assert set(placed.values()) == {"n3"}
+    # and the baseline without extenders spreads (sanity that the extender
+    # actually changed the outcome)
+    base = simulate(ClusterResource(nodes=_nodes(5)), apps)
+    base_nodes = {
+        st.node.name for st in base.node_status for _ in st.pods
+    }
+    assert base_nodes != {"n3"}
+
+
+def test_prioritize_changes_placement(stub_factory):
+    # all nodes pass the filter; extender scores n2 max -> ×10 × weight 3
+    # dominates the framework's balanced/least-allocated signal
+    stub = stub_factory({"scores": {"n2": 10}})
+    cluster = ClusterResource(nodes=_nodes(4))
+    apps = [AppResource(name="a", objects=[_deploy(replicas=2, cpu="100m")])]
+    res = simulate(cluster, apps, extenders=[_ext(stub.url, weight=3)])
+    assert not res.unscheduled
+    nodes_used = {
+        st.node.name for st in res.node_status if st.pods
+    }
+    assert nodes_used == {"n2"}
+
+
+def test_filter_failed_nodes_reason(stub_factory):
+    stub = stub_factory(
+        {"allow": set(), "failed": {"n0": "out of quota", "n1": "out of quota"}}
+    )
+    cluster = ClusterResource(nodes=_nodes(2))
+    apps = [AppResource(name="a", objects=[_deploy(replicas=1)])]
+    res = simulate(cluster, apps, extenders=[_ext(stub.url)])
+    assert len(res.unscheduled) == 1
+    reason = res.unscheduled[0].reason
+    assert reason.startswith("0/2 nodes are available")
+    assert "out of quota" in reason
+
+
+def test_extender_error_fails_pod_unless_ignorable(stub_factory):
+    stub = stub_factory({"error": "backend exploded"})
+    cluster = ClusterResource(nodes=_nodes(2))
+    apps = [AppResource(name="a", objects=[_deploy(replicas=1)])]
+    res = simulate(cluster, apps, extenders=[_ext(stub.url)])
+    assert len(res.unscheduled) == 1
+    assert "backend exploded" in res.unscheduled[0].reason
+    # ignorable: the same failure is skipped and scheduling proceeds
+    res2 = simulate(
+        ClusterResource(nodes=_nodes(2)),
+        apps,
+        extenders=[_ext(stub.url, ignorable=True)],
+    )
+    assert not res2.unscheduled
+
+
+def test_unreachable_ignorable_extender(stub_factory):
+    cfg = _ext("http://127.0.0.1:9", ignorable=True, http_timeout_s=0.5)
+    cluster = ClusterResource(nodes=_nodes(2))
+    apps = [AppResource(name="a", objects=[_deploy(replicas=1)])]
+    res = simulate(cluster, apps, extenders=[cfg])
+    assert not res.unscheduled
+
+
+def test_managed_resources_gating(stub_factory):
+    # the extender manages example.com/widget; plain pods never reach it
+    stub = stub_factory({"allow": set()})
+    cfg = _ext(stub.url, managed_resources=["example.com/widget"])
+    cluster = ClusterResource(nodes=_nodes(2))
+    apps = [AppResource(name="a", objects=[_deploy(replicas=1)])]
+    res = simulate(cluster, apps, extenders=[cfg])
+    assert not res.unscheduled          # extender was never consulted
+    assert stub.calls == []
+
+
+def test_node_cache_capable_wire_format(stub_factory):
+    stub = stub_factory({"allow": {"n1"}})
+    cluster = ClusterResource(nodes=_nodes(3))
+    apps = [AppResource(name="a", objects=[_deploy(replicas=1)])]
+    res = simulate(
+        cluster, apps, extenders=[_ext(stub.url, node_cache_capable=True)]
+    )
+    assert not res.unscheduled
+    assert res.node_status and all(
+        st.node.name == "n1" for st in res.node_status if st.pods
+    )
+    # nodeCacheCapable sends NodeNames, not full Node objects
+    path, body = stub.calls[0]
+    assert body.get("NodeNames") is not None
+    assert body.get("Nodes") is None
+    assert body["Pod"]["metadata"]["name"]
+
+
+def test_oracle_parity_with_noop_extender(stub_factory):
+    """A pass-through extender must not change any placement: the per-pod
+    probe→commit path is bit-identical to the batch scan."""
+    stub = stub_factory({})   # allow None = keep all, scores all 0
+    cluster1 = ClusterResource(nodes=_nodes(6, cpu="4"))
+    cluster2 = ClusterResource(nodes=_nodes(6, cpu="4"))
+    apps = [
+        AppResource(
+            name="a",
+            objects=[_deploy(replicas=9, cpu="500m"), _deploy(replicas=4, cpu="2", name="e")],
+        )
+    ]
+    base = simulate(cluster1, apps)
+    ext = simulate(cluster2, apps, extenders=[_ext(stub.url)])
+    # pod names carry RNG suffixes; compare the placement multiset per
+    # workload instead
+    key = lambda r: sorted(
+        (
+            p.meta.namespace,
+            p.meta.annotations.get("simon/workload-name", p.meta.name),
+            st.node.name,
+        )
+        for st in r.node_status
+        for p in st.pods
+    )
+    assert key(base) == key(ext)
+    assert not base.unscheduled and not ext.unscheduled
+
+
+def test_config_parsing(tmp_path):
+    cfg_file = tmp_path / "sched.yaml"
+    cfg_file.write_text(
+        """
+kind: KubeSchedulerConfiguration
+extenders:
+  - urlPrefix: http://svc:8000/ext
+    filterVerb: filter
+    prioritizeVerb: prioritize
+    weight: 2
+    httpTimeout: 5s
+    nodeCacheCapable: true
+    ignorable: true
+    managedResources:
+      - name: example.com/gpu
+        ignoredByScheduler: true
+"""
+    )
+    cfg = load_scheduler_config(str(cfg_file))
+    assert len(cfg.extenders) == 1
+    e = cfg.extenders[0]
+    assert e.url_prefix == "http://svc:8000/ext"
+    assert e.weight == 2 and e.http_timeout_s == 5.0
+    assert e.node_cache_capable and e.ignorable
+    assert e.managed_resources == ["example.com/gpu"]
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text(
+        "kind: KubeSchedulerConfiguration\nextenders:\n  - urlPrefix: http://x\n    bindVerb: bind\n"
+    )
+    with pytest.raises(ValueError, match="neither filterVerb nor prioritizeVerb"):
+        load_scheduler_config(str(bad))
