@@ -100,9 +100,15 @@ class HTTPExtender:
 
     # -- extender.go:440-468 ------------------------------------------------
     def is_interested(self, pod: Pod) -> bool:
+        """managedResources empty = every pod; otherwise the pod must name a
+        managed resource under requests OR limits (hasManagedResources scans
+        both, extender.go:448-468 — a limits-only extended resource still
+        routes the pod through the extender)."""
         if not self.managed:
             return True
-        return any(r in self.managed for r in pod.requests)
+        return any(r in self.managed for r in pod.requests) or any(
+            r in self.managed for r in pod.limits
+        )
 
     @property
     def is_ignorable(self) -> bool:

@@ -645,12 +645,7 @@ class Simulator:
             self._probe_fit_jit = probe_fit
 
         row_cache: Dict[str, object] = {}
-
-        if not hasattr(self, "_name_index"):
-            self._name_index = {
-                name: i for i, name in enumerate(self._table.names)
-            }
-        name_index = self._name_index
+        name_index = self._name_index_map()
 
         def fits(pod: Pod, node, remaining) -> bool:
             ni = name_index[node.name]

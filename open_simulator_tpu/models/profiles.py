@@ -93,6 +93,35 @@ class SchedulerProfile:
         return np.asarray(self.filters_enabled, bool)
 
 
+_GO_DURATION_UNITS = {
+    "ns": 1e-9, "us": 1e-6, "µs": 1e-6, "ms": 1e-3,
+    "s": 1.0, "m": 60.0, "h": 3600.0,
+}
+
+
+def _parse_go_duration(s: str) -> Optional[float]:
+    """metav1.Duration / Go time.ParseDuration subset: one or more
+    (number)(unit) segments, e.g. "5s", "1m30s", "100ms". Returns seconds,
+    or None when the string is not a valid duration."""
+    import re as _re
+
+    if not s:
+        return None
+    total = 0.0
+    pos = 0
+    seg = _re.compile(r"([\d.]+)(ns|us|µs|ms|s|m|h)")
+    while pos < len(s):
+        m = seg.match(s, pos)
+        if not m:
+            return None
+        try:
+            total += float(m.group(1)) * _GO_DURATION_UNITS[m.group(2)]
+        except ValueError:
+            return None
+        pos = m.end()
+    return total
+
+
 @dataclass
 class ExtenderConfig:
     """One `extenders:` entry of a KubeSchedulerConfiguration (parity:
@@ -121,13 +150,12 @@ class ExtenderConfig:
         if isinstance(timeout, (int, float)):
             seconds = float(timeout)
         elif isinstance(timeout, str) and timeout:
-            # metav1.Duration strings: "5s", "300ms", "1m"
-            import re as _re
-
-            m = _re.fullmatch(r"([\d.]+)(ms|s|m|h)", timeout.strip())
-            if m:
-                mult = {"ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0}[m.group(2)]
-                seconds = float(m.group(1)) * mult
+            parsed = _parse_go_duration(timeout.strip())
+            if parsed is None:
+                raise ValueError(
+                    f"extender httpTimeout: invalid duration {timeout!r}"
+                )
+            seconds = parsed
         return ExtenderConfig(
             url_prefix=d.get("urlPrefix", "") or "",
             filter_verb=d.get("filterVerb", "") or "",

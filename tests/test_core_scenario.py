@@ -1,0 +1,521 @@
+"""Faithful port of the reference's full integration scenario.
+
+Parity target: `/root/reference/pkg/simulator/core_test.go:32-361` (the
+"simple" TestSimulate case) and its `checkResult` oracle (`:364-591`):
+
+  cluster = master-1 (tainted, local storage) + master-2 + master-3 +
+            worker-1 (local storage), 4 static pods pre-bound to master-1,
+            a metrics-server Deployment with node-affinity (master Exists) +
+            required pod-anti-affinity on a zone topology key, and 3
+            DaemonSets (kube-proxy-master / kube-proxy-worker / coredns)
+            with taints/selectors/affinity;
+  app "simple" = Deployment busybox-deploy (4×1500m/1Gi, tolerates the
+            master taint), DaemonSet busybox-ds (worker-only via
+            DoesNotExist affinity), Job pi, bare Pod single-pod (master
+            nodeSelector + toleration), StatefulSet busybox-sts (4 replicas,
+            preferred pod-anti-affinity), ReplicaSet calico-kube-controllers
+            (2 replicas, request-less, tolerates everything);
+  oracle = failedPodsNum == 0, per-workload pod-count conservation
+            (DaemonSet expectations recomputed per node via the daemon
+            controller predicates), and individual-pod count conservation.
+
+The workload templates here intentionally carry NO labels — the reference's
+pkg/test factories don't set any (statefulset.go:15-45 etc.), which makes the
+busybox-sts preferred anti-affinity vacuously inert exactly as it is in the
+reference run.
+"""
+
+import json
+
+from open_simulator_tpu.core.objects import Node, Pod
+from open_simulator_tpu.core.workloads import daemonset_pods, expected_pod_counts
+from open_simulator_tpu.engine.simulator import (
+    AppResource,
+    ClusterResource,
+    simulate,
+)
+
+MASTER_LABELS = {
+    "beta.kubernetes.io/arch": "amd64",
+    "beta.kubernetes.io/os": "linux",
+    "kubernetes.io/arch": "amd64",
+    "kubernetes.io/os": "linux",
+    "node-role.kubernetes.io/master": "",
+}
+WORKER_LABELS = {
+    "beta.kubernetes.io/arch": "amd64",
+    "beta.kubernetes.io/os": "linux",
+    "kubernetes.io/arch": "amd64",
+    "kubernetes.io/os": "linux",
+    "node-role.kubernetes.io/worker": "",
+}
+
+# utils.NodeStorage JSON exactly as WithNodeLocalStorage encodes it
+# (core_test.go:60-80; SharedResource/ExclusiveResource with 100Gi pools)
+LOCAL_STORAGE = json.dumps(
+    {
+        "vgs": [
+            {"name": "yoda-pool0", "capacity": 107374182400},
+            {"name": "yoda-pool1", "capacity": 107374182400},
+        ],
+        "devices": [
+            {
+                "name": "/dev/vdd",
+                "device": "/dev/vdd",
+                "capacity": 107374182400,
+                "isAllocated": False,
+                "mediaType": "hdd",
+            }
+        ],
+    }
+)
+
+
+def _node(name, labels, tainted=False, storage=False):
+    """MakeFakeNode parity (node.go:15-40): 8 cpu / 16Gi / 110 pods."""
+    meta = {
+        "name": name,
+        "labels": {"kubernetes.io/hostname": name, **labels},
+        "annotations": (
+            {"simon/node-local-storage": LOCAL_STORAGE} if storage else {}
+        ),
+    }
+    spec = {}
+    if tainted:
+        spec["taints"] = [
+            {"key": "node-role.kubernetes.io/master", "effect": "NoSchedule"}
+        ]
+    res = {"cpu": "8", "memory": "16Gi", "pods": "110"}
+    return Node.from_dict(
+        {
+            "metadata": meta,
+            "spec": spec,
+            "status": {"allocatable": dict(res), "capacity": dict(res)},
+        }
+    )
+
+
+def _static_pod(name, cpu):
+    """MakeFakePod + WithPodNodeName (pod.go:13-47): pre-bound to master-1,
+    empty resource strings mean no request at all."""
+    res = {}
+    if cpu:
+        res["cpu"] = cpu
+    return Pod.from_dict(
+        {
+            "metadata": {"name": name, "namespace": "kube-system"},
+            "spec": {
+                "nodeName": "master-1",
+                "containers": [
+                    {
+                        "name": "container",
+                        "image": "nginx",
+                        "resources": {"requests": res},
+                    }
+                ],
+            },
+        }
+    )
+
+
+def _tmpl_spec(cpu, memory, tolerations=None, node_selector=None, affinity=None):
+    """Reference pkg/test template: single container, NO labels."""
+    res = {}
+    if cpu:
+        res["cpu"] = cpu
+    if memory:
+        res["memory"] = memory
+    spec = {
+        "containers": [
+            {"name": "container", "image": "nginx", "resources": {"requests": res}}
+        ]
+    }
+    if tolerations:
+        spec["tolerations"] = tolerations
+    if node_selector:
+        spec["nodeSelector"] = node_selector
+    if affinity:
+        spec["affinity"] = affinity
+    return spec
+
+
+def _workload(kind, name, ns, spec_extra, tmpl):
+    return {
+        "kind": kind,
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {**spec_extra, "template": {"metadata": {}, "spec": tmpl}},
+    }
+
+
+MASTER_EXISTS_AFFINITY = {
+    "nodeAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": {
+            "nodeSelectorTerms": [
+                {
+                    "matchExpressions": [
+                        {
+                            "key": "node-role.kubernetes.io/master",
+                            "operator": "Exists",
+                        }
+                    ]
+                }
+            ]
+        }
+    }
+}
+
+
+def _build_cluster():
+    nodes = [
+        _node("master-1", MASTER_LABELS, tainted=True, storage=True),
+        _node("master-2", MASTER_LABELS),
+        _node("master-3", MASTER_LABELS),
+        _node("worker-1", WORKER_LABELS, storage=True),
+    ]
+    static_pods = [
+        _static_pod("etcd-master-1", ""),
+        _static_pod("kube-apiserver-master-1", "250m"),
+        _static_pod("kube-controller-manager-master-1", "200m"),
+        _static_pod("kube-scheduler-master-1", "100m"),
+    ]
+    metrics_server = _workload(
+        "Deployment", "metrics-server", "kube-system",
+        {"replicas": 1},
+        _tmpl_spec(
+            "1", "500Mi",
+            affinity={
+                **MASTER_EXISTS_AFFINITY,
+                "podAntiAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [
+                        {
+                            "labelSelector": {
+                                "matchLabels": {"k8s-app": "metrics-server"}
+                            },
+                            "topologyKey": "failure-domain.beta.kubernetes.io/zone",
+                        }
+                    ]
+                },
+            },
+        ),
+    )
+    daemonsets = [
+        _workload(
+            "DaemonSet", "kube-proxy-master", "kube-system", {},
+            _tmpl_spec(
+                "", "",
+                tolerations=[{"operator": "Exists"}],
+                node_selector={"node-role.kubernetes.io/master": ""},
+            ),
+        ),
+        _workload(
+            "DaemonSet", "kube-proxy-worker", "kube-system", {},
+            _tmpl_spec(
+                "", "",
+                tolerations=[{"operator": "Exists"}],
+                node_selector={"node-role.kubernetes.io/worker": ""},
+            ),
+        ),
+        _workload(
+            "DaemonSet", "coredns", "kube-system", {},
+            _tmpl_spec(
+                "100m", "70Mi",
+                tolerations=[
+                    {
+                        "effect": "NoSchedule",
+                        "key": "node-role.kubernetes.io/master",
+                    }
+                ],
+                node_selector={"beta.kubernetes.io/os": "linux"},
+                affinity=MASTER_EXISTS_AFFINITY,
+            ),
+        ),
+    ]
+    cluster = ClusterResource(
+        nodes=nodes,
+        pods=static_pods,
+        daemonsets=daemonsets,
+        others={},
+    )
+    # non-DaemonSet cluster workloads ride in the first app position the way
+    # RunCluster schedules them with the cluster's own pending pods
+    return cluster, metrics_server
+
+
+def _build_app():
+    master_toleration = [
+        {
+            "effect": "NoSchedule",
+            "key": "node-role.kubernetes.io/master",
+            "operator": "Exists",
+        }
+    ]
+    objects = [
+        _workload(
+            "Deployment", "busybox-deploy", "simple", {"replicas": 4},
+            _tmpl_spec("1500m", "1Gi", tolerations=master_toleration),
+        ),
+        _workload(
+            "DaemonSet", "busybox-ds", "simple", {},
+            _tmpl_spec(
+                "500m", "512Mi",
+                node_selector={"beta.kubernetes.io/os": "linux"},
+                affinity={
+                    "nodeAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": {
+                            "nodeSelectorTerms": [
+                                {
+                                    "matchExpressions": [
+                                        {
+                                            "key": "node-role.kubernetes.io/master",
+                                            "operator": "DoesNotExist",
+                                        }
+                                    ]
+                                }
+                            ]
+                        }
+                    }
+                },
+            ),
+        ),
+        _workload(
+            "Job", "pi", "default", {"completions": 1, "parallelism": 1},
+            _tmpl_spec("100m", "100Mi"),
+        ),
+        {
+            "kind": "Pod",
+            "metadata": {"name": "single-pod", "namespace": "simple"},
+            "spec": {
+                **_tmpl_spec(
+                    "100m", "100Mi",
+                    tolerations=[
+                        {
+                            "effect": "NoSchedule",
+                            "key": "node-role.kubernetes.io/master",
+                            "operator": "Exists",
+                        }
+                    ],
+                    node_selector={"node-role.kubernetes.io/master": ""},
+                ),
+            },
+        },
+        _workload(
+            "StatefulSet", "busybox-sts", "simple", {"replicas": 4},
+            _tmpl_spec(
+                "1", "512Mi",
+                tolerations=master_toleration,
+                affinity={
+                    "podAntiAffinity": {
+                        "preferredDuringSchedulingIgnoredDuringExecution": [
+                            {
+                                "weight": 100,
+                                "podAffinityTerm": {
+                                    "labelSelector": {
+                                        "matchExpressions": [
+                                            {
+                                                "key": "app",
+                                                "operator": "In",
+                                                "values": ["busybox-sts"],
+                                            }
+                                        ]
+                                    },
+                                    "topologyKey": "kubernetes.io/hostname",
+                                },
+                            }
+                        ]
+                    }
+                },
+            ),
+        ),
+        _workload(
+            "ReplicaSet", "calico-kube-controllers", "kube-system",
+            {"replicas": 2},
+            _tmpl_spec(
+                "", "",
+                tolerations=[
+                    {"effect": "NoSchedule", "operator": "Exists"},
+                    {"key": "CriticalAddonsOnly", "operator": "Exists"},
+                    {"effect": "NoExecute", "operator": "Exists"},
+                ],
+            ),
+        ),
+    ]
+    return AppResource(name="simple", objects=objects)
+
+
+def _check_result(cluster, all_workloads, result, failed_pods_num=0):
+    """checkResult parity (core_test.go:364-591): exact per-workload counts
+    + individual-pod conservation, DaemonSet expectations recomputed from the
+    daemon-controller predicates per node."""
+    assert len(result.unscheduled) == failed_pods_num, [
+        (u.pod.key, u.reason) for u in result.unscheduled
+    ]
+
+    all_pods = [p for st in result.node_status for p in st.pods]
+    all_pods += [u.pod for u in result.unscheduled]
+
+    expected = expected_pod_counts(all_workloads, cluster.nodes)
+    # individual pods (static + bare app pods) are keyed as Pod/<ns>/<name>
+    expected_individual = sum(
+        n for key, n in expected.items() if key.startswith("Pod/")
+    )
+    expected_workloads = {
+        key: n for key, n in expected.items() if not key.startswith("Pod/")
+    }
+
+    got_workloads = {key: 0 for key in expected_workloads}
+    got_individual = 0
+    for pod in all_pods:
+        kind = pod.meta.annotations.get("simon/workload-kind", "")
+        name = pod.meta.annotations.get("simon/workload-name", "")
+        ns = pod.meta.annotations.get("simon/workload-namespace", "")
+        if not kind:
+            got_individual += 1
+            continue
+        key = f"{kind}/{ns or 'default'}/{name}"
+        # checkResult's owner-kind indirection (core_test.go:519-546):
+        # Deployment pods are ReplicaSet-owned — attribute to the Deployment
+        # when no ReplicaSet of that name exists; likewise CronJob pods are
+        # Job-owned.
+        if key not in got_workloads and kind == "ReplicaSet":
+            key = f"Deployment/{ns or 'default'}/{name}"
+        if key not in got_workloads and kind == "Job":
+            key = f"CronJob/{ns or 'default'}/{name}"
+        assert key in got_workloads, f"pod {pod.key} from unexpected {key}"
+        got_workloads[key] += 1
+
+    assert got_workloads == expected_workloads
+    assert got_individual == expected_individual
+
+
+def test_core_scenario_simple():
+    cluster, metrics_server = _build_cluster()
+    app = _build_app()
+    # metrics-server is a cluster Deployment in the reference fixture; our
+    # ClusterResource carries non-DaemonSet workloads through an app entry
+    # scheduled first (RunCluster order: cluster pods+DaemonSets, then apps)
+    cluster_app = AppResource(name="cluster-workloads", objects=[metrics_server])
+    result = simulate(cluster, [cluster_app, app])
+
+    all_workloads = (
+        [metrics_server]
+        + list(cluster.daemonsets)
+        + app.objects
+        + [
+            {"kind": "Pod", "metadata": {"name": p.meta.name,
+                                         "namespace": p.meta.namespace}}
+            for p in cluster.pods
+        ]
+    )
+    _check_result(cluster, all_workloads, result, failed_pods_num=0)
+
+    placed = {
+        p.meta.name if not p.meta.annotations.get("simon/workload-name")
+        else p.meta.annotations["simon/workload-name"]: st.node.name
+        for st in result.node_status
+        for p in st.pods
+    }
+    by_node = {
+        st.node.name: [p for p in st.pods] for st in result.node_status
+    }
+
+    # static pods stayed pre-bound on master-1
+    master1 = {p.meta.name for p in by_node["master-1"]}
+    assert {"etcd-master-1", "kube-apiserver-master-1",
+            "kube-controller-manager-master-1",
+            "kube-scheduler-master-1"} <= master1
+
+    def nodes_of(workload):
+        return {
+            st.node.name
+            for st in result.node_status
+            for p in st.pods
+            if p.meta.annotations.get("simon/workload-name") == workload
+        }
+
+    # DaemonSet placement follows the daemon-controller predicates exactly
+    assert nodes_of("kube-proxy-master") == {"master-1", "master-2", "master-3"}
+    assert nodes_of("kube-proxy-worker") == {"worker-1"}
+    assert nodes_of("coredns") == {"master-1", "master-2", "master-3"}
+    assert nodes_of("busybox-ds") == {"worker-1"}
+
+    # metrics-server: node-affinity restricts to masters, and without a
+    # toleration the master-1 taint excludes it -> master-2 or master-3
+    assert nodes_of("metrics-server") <= {"master-2", "master-3"}
+
+    # single-pod: master nodeSelector + toleration -> any master
+    single_nodes = {
+        st.node.name
+        for st in result.node_status
+        for p in st.pods
+        if p.meta.name == "single-pod"
+    }
+    assert single_nodes <= {"master-1", "master-2", "master-3"}
+    assert len(single_nodes) == 1
+
+    # the DaemonSet eligibility oracle agrees with the per-node expansion
+    for ds in cluster.daemonsets + [app.objects[1]]:
+        expected_nodes = {
+            p.node_name or n.name
+            for n in cluster.nodes
+            for p in daemonset_pods(ds, [n])
+        }
+        name = ds["metadata"]["name"]
+        assert nodes_of(name) == expected_nodes, name
+
+
+def test_core_scenario_overload_fails_exact_count():
+    """The same cluster with the app scaled past capacity reports exactly the
+    overflow as unscheduled (failedPodsNum-style assertion with a non-zero
+    expectation)."""
+    cluster, metrics_server = _build_cluster()
+    # 4 nodes × 8 cpu; busybox-deploy at 1500m per replica: the cluster fits
+    # only so many after the cluster workloads — ask for far more
+    objects = [
+        _workload(
+            "Deployment", "busybox-deploy", "simple", {"replicas": 30},
+            _tmpl_spec(
+                "1500m", "1Gi",
+                tolerations=[
+                    {
+                        "effect": "NoSchedule",
+                        "key": "node-role.kubernetes.io/master",
+                        "operator": "Exists",
+                    }
+                ],
+            ),
+        ),
+    ]
+    app = AppResource(name="overload", objects=objects)
+    cluster_app = AppResource(name="cluster-workloads", objects=[metrics_server])
+    result = simulate(cluster, [cluster_app, app])
+    # capacity arithmetic: per node 8000m minus cluster pods' requests;
+    # every unscheduled pod must be a busybox-deploy replica and the
+    # conservation oracle still balances
+    assert result.unscheduled
+    assert all(
+        u.pod.meta.annotations.get("simon/workload-name") == "busybox-deploy"
+        for u in result.unscheduled
+    )
+    all_workloads = (
+        [metrics_server] + list(cluster.daemonsets) + objects
+        + [
+            {"kind": "Pod", "metadata": {"name": p.meta.name,
+                                         "namespace": p.meta.namespace}}
+            for p in cluster.pods
+        ]
+    )
+    _check_result(
+        cluster, all_workloads, result,
+        failed_pods_num=len(result.unscheduled),
+    )
+    placed = sum(
+        1
+        for st in result.node_status
+        for p in st.pods
+        if p.meta.annotations.get("simon/workload-name") == "busybox-deploy"
+    )
+    assert placed + len(result.unscheduled) == 30
+    # every unscheduled reason names the actual blockers
+    for u in result.unscheduled:
+        assert u.reason.startswith("0/4 nodes are available")
+        assert "Insufficient" in u.reason

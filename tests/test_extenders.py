@@ -333,3 +333,48 @@ extenders:
     )
     with pytest.raises(ValueError, match="neither filterVerb nor prioritizeVerb"):
         load_scheduler_config(str(bad))
+
+
+def test_go_duration_parsing():
+    from open_simulator_tpu.models.profiles import ExtenderConfig
+
+    assert ExtenderConfig.from_dict({"httpTimeout": "1m30s"}).http_timeout_s == 90.0
+    assert ExtenderConfig.from_dict({"httpTimeout": "100ms"}).http_timeout_s == 0.1
+    assert ExtenderConfig.from_dict({"httpTimeout": "2h"}).http_timeout_s == 7200.0
+    assert ExtenderConfig.from_dict({}).http_timeout_s == 30.0
+    with pytest.raises(ValueError, match="invalid duration"):
+        ExtenderConfig.from_dict({"httpTimeout": "fast"})
+
+
+def test_limits_only_managed_resource_is_interesting():
+    from open_simulator_tpu.core.objects import Pod
+    from open_simulator_tpu.engine.extenders import HTTPExtender
+    from open_simulator_tpu.models.profiles import ExtenderConfig
+
+    ext = HTTPExtender(
+        ExtenderConfig(
+            url_prefix="http://x", filter_verb="filter",
+            managed_resources=["example.com/widget"],
+        )
+    )
+    limits_only = Pod.from_dict(
+        {
+            "metadata": {"name": "p", "namespace": "d"},
+            "spec": {
+                "containers": [
+                    {
+                        "name": "c",
+                        "resources": {"limits": {"example.com/widget": "1"}},
+                    }
+                ]
+            },
+        }
+    )
+    plain = Pod.from_dict(
+        {
+            "metadata": {"name": "q", "namespace": "d"},
+            "spec": {"containers": [{"name": "c"}]},
+        }
+    )
+    assert ext.is_interested(limits_only)
+    assert not ext.is_interested(plain)
