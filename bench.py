@@ -441,12 +441,50 @@ def config_plan(n_pods=100_000, n_nodes=10_000):
     }
 
 
+def config_preempt(n_nodes=60, n_low=400, n_high=100):
+    """Config 6: priority-tiered preemption. A low-priority tier fills the
+    cluster (400 x 1cpu on 60 x 8cpu = 80 cpu headroom), then a
+    high-priority tier (100 x 2cpu, priority 100) arrives: ~40 pods fit in
+    the headroom and the rest must evict low-priority victims through the
+    lane-parallel batched probe path (engine/preemption.try_preempt with
+    fits_many_fn). Measures the cost the reference pays in
+    selectVictimsOnNode's per-node filter dry runs
+    (default_preemption.go:578-626)."""
+    from open_simulator_tpu.engine.simulator import (
+        AppResource,
+        ClusterResource,
+        simulate,
+    )
+
+    nodes = [_mk_node(f"n-{i}", "8", "32Gi") for i in range(n_nodes)]
+    low = _mk_deploy("low-tier", n_low, "1", "1Gi")
+    high = _mk_deploy(
+        "high-tier", n_high, "2", "1Gi", spec_extra={"priority": 100}
+    )
+    t0 = time.time()
+    result = simulate(
+        ClusterResource(nodes=nodes),
+        [AppResource(name="bench", objects=[low, high])],
+    )
+    wall = time.time() - t0
+    placed = sum(len(st.pods) for st in result.node_status)
+    n_pods = n_low + n_high
+    return {
+        "wall_s": round(wall, 2),
+        "value": round(n_pods / wall, 1),
+        "scheduled": placed,
+        "unscheduled": len(result.unscheduled),
+        "preempted": len(result.preempted),
+    }
+
+
 CONFIGS = {
     "stock": config_stock,
     "fit_1k_100n": config_fit,
     "spread_aff_10k_1k": config_spread_affinity,
     "gpushare_5k": config_gpushare,
     "plan_100k_10k": config_plan,
+    "preempt_tiered": config_preempt,
 }
 
 

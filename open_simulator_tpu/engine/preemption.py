@@ -164,6 +164,83 @@ def _more_important(p: Pod) -> Tuple:
     return (-p.priority,)
 
 
+def _victim_candidates(
+    pod: Pod,
+    bound: Sequence[Pod],
+    pdbs: Sequence[PodDisruptionBudget],
+    pdb_allowed: Dict[int, int],
+) -> Optional[Tuple[List[Pod], List[Tuple[Pod, bool]]]]:
+    """The deterministic prefix of selectVictimsOnNode: (keep, ordered
+    reprieve queue of (pod, violates_pdb)). Victims process in MoreImportant
+    order, PDB-violating first, budgets decremented per candidate (:736)."""
+    potential = [p for p in bound if p.priority < pod.priority]
+    if not potential:
+        return None
+    keep = [p for p in bound if p.priority >= pod.priority]
+    potential.sort(key=_more_important)
+    allowed = dict(pdb_allowed)
+    violating: List[Pod] = []
+    non_violating: List[Pod] = []
+    for p in potential:
+        is_violating = False
+        for i, pdb in enumerate(pdbs):
+            if pdb.matches(p):
+                allowed[i] = allowed.get(i, 0) - 1
+                if allowed[i] < 0:
+                    is_violating = True
+        (violating if is_violating else non_violating).append(p)
+    queue = [(p, True) for p in violating] + [(p, False) for p in non_violating]
+    return keep, queue
+
+
+@dataclass
+class _Lane:
+    """One candidate node's reprieve state in the lane driver."""
+    node: Node
+    remaining: List[Pod]
+    queue: List[Tuple[Pod, bool]]
+    victims: List[Pod]
+    num_violating: int = 0
+
+
+def _drive_lanes(pod: Pod, lanes: List[_Lane], fits_many_fn) -> List[PreemptionResult]:
+    """The single reprieve implementation (selectVictimsOnNode's loop,
+    :595-660) run over any number of lanes in lockstep rounds: round 0 checks
+    fit with every potential victim evicted, then each round every active
+    lane tries to reprieve its k-th queued victim. Per-lane semantics are
+    exactly the sequential algorithm — lanes are independent. Lanes whose
+    reprieve run ends with no victims are dropped (the pod's real failure
+    was a filter preemption can't fix there)."""
+    if not lanes:
+        return []
+    fit0 = fits_many_fn(pod, [(l.node, l.remaining) for l in lanes])
+    lanes = [l for l, ok in zip(lanes, fit0) if ok]
+    max_q = max((len(l.queue) for l in lanes), default=0)
+    for k in range(max_q):
+        active = [l for l in lanes if k < len(l.queue)]
+        if not active:
+            break
+        results = fits_many_fn(
+            pod, [(l.node, l.remaining + [l.queue[k][0]]) for l in active]
+        )
+        for lane, ok in zip(active, results):
+            p, is_violating = lane.queue[k]
+            if ok:
+                lane.remaining.append(p)   # reprieved
+            else:
+                lane.victims.append(p)
+                if is_violating:
+                    lane.num_violating += 1
+    return [
+        PreemptionResult(
+            node=l.node.name, victims=l.victims,
+            num_pdb_violations=l.num_violating,
+        )
+        for l in lanes
+        if l.victims
+    ]
+
+
 def select_victims_on_node(
     pod: Pod,
     node: Node,
@@ -178,55 +255,22 @@ def select_victims_on_node(
     a copy).
 
     `fits_fn(pod, node, remaining) -> bool` overrides the host-side
-    resources-only fit model; the engine passes the device filter kernel
-    (Simulator._device_fits) so victim selection sees the FULL filter set —
-    spread/affinity/storage/GPU/ports — exactly like the reference's dry-run
-    of the filter plugins on the post-eviction node (:598-626)."""
+    resources-only fit model. Implemented as a one-lane run of the shared
+    lane driver so there is exactly one reprieve implementation."""
     fits = fits_fn or _fits
-    potential = [p for p in bound if p.priority < pod.priority]
-    if not potential:
+    got = _victim_candidates(pod, bound, pdbs, pdb_allowed)
+    if got is None:
         return None
-    keep = [p for p in bound if p.priority >= pod.priority]
-    if not fits(pod, node, keep):
-        return None
+    keep, queue = got
 
-    potential.sort(key=_more_important)
-    # split by PDB violation, decrementing budgets per selected victim (:736)
-    allowed = dict(pdb_allowed)
-    violating: List[Pod] = []
-    non_violating: List[Pod] = []
-    for p in potential:
-        is_violating = False
-        for i, pdb in enumerate(pdbs):
-            if pdb.matches(p):
-                allowed[i] = allowed.get(i, 0) - 1
-                if allowed[i] < 0:
-                    is_violating = True
-        (violating if is_violating else non_violating).append(p)
+    def fits_many(pod2, items):
+        return [fits(pod2, n, remaining) for n, remaining in items]
 
-    victims: List[Pod] = []
-    num_violating = 0
-    remaining = list(keep)
-
-    def reprieve(p: Pod) -> bool:
-        remaining.append(p)
-        if fits(pod, node, remaining):
-            return True
-        remaining.pop()
-        victims.append(p)
-        return False
-
-    for p in violating:
-        if not reprieve(p):
-            num_violating += 1
-    for p in non_violating:
-        reprieve(p)
-    if not victims:
-        # Every candidate was reprieved: the pod fits without evictions under
-        # this host-side resource model, so its real failure was a filter
-        # preemption can't resolve here — don't nominate this node.
-        return None
-    return PreemptionResult(node=node.name, victims=victims, num_pdb_violations=num_violating)
+    out = _drive_lanes(
+        pod, [_Lane(node=node, remaining=list(keep), queue=queue, victims=[])],
+        fits_many,
+    )
+    return out[0] if out else None
 
 
 def pick_one_node(candidates: List[PreemptionResult]) -> Optional[PreemptionResult]:
@@ -258,8 +302,19 @@ def try_preempt(
     bound_by_node: Dict[str, List[Pod]],
     pdbs: Sequence[PodDisruptionBudget],
     fits_fn=None,
+    fits_many_fn=None,
 ) -> Optional[PreemptionResult]:
-    """Full PostFilter: find the best node + minimal victim set, or None."""
+    """Full PostFilter: find the best node + minimal victim set, or None.
+
+    `fits_many_fn(pod, [(node, remaining), ...]) -> [bool]` enables the
+    lane-parallel driver: every candidate node advances its reprieve loop in
+    lockstep rounds, so one preemptor costs 1 + max(queue length) batched fit
+    evaluations instead of sum over nodes of (1 + queue length) single
+    probes. Per-lane semantics are identical to select_victims_on_node —
+    lanes are independent (budgets are per-candidate copies, :736). This is
+    the engine's analog of the reference evaluating selectVictimsOnNode in
+    parallel goroutines over candidate nodes (default_preemption.go:560-576).
+    """
     if pod.preemption_policy == "Never":
         return None  # PodEligibleToPreemptOthers (:231)
     # budgets from current healthy counts
@@ -268,14 +323,22 @@ def try_preempt(
         i: pdb.allowed_disruptions(sum(1 for p in all_bound if pdb.matches(p)))
         for i, pdb in enumerate(pdbs)
     }
-    candidates: List[PreemptionResult] = []
+    if fits_many_fn is None:
+        fits = fits_fn or _fits
+
+        def fits_many_fn(pod2, items):   # one-probe-per-call adapter
+            return [fits(pod2, n, remaining) for n, remaining in items]
+
+    lanes: List[_Lane] = []
     for node in nodes:
         if not _static_unresolvable_ok(pod, node):
             continue
-        res = select_victims_on_node(
-            pod, node, bound_by_node.get(node.name, []), pdbs, pdb_allowed,
-            fits_fn=fits_fn,
+        got = _victim_candidates(
+            pod, bound_by_node.get(node.name, []), pdbs, pdb_allowed
         )
-        if res is not None:
-            candidates.append(res)
-    return pick_one_node(candidates)
+        if got is None:
+            continue
+        keep, queue = got
+        lanes.append(_Lane(node=node, remaining=list(keep), queue=queue,
+                           victims=[]))
+    return pick_one_node(_drive_lanes(pod, lanes, fits_many_fn))

@@ -605,50 +605,142 @@ class Simulator:
         return f"0/{n_nodes} nodes are available: {detail}."
 
     # -- preemption (PostFilter) -------------------------------------------
-    def _device_fits(self, bound_by_node):
-        """fits_fn for victim selection that runs the REAL filter kernel on
-        the candidate node's post-eviction state (parity:
+    # lanes per batched probe call: bounds vmap memory on huge clusters
+    # (each lane's run_filters is O(N) work) while keeping the jit cache to
+    # a handful of bucketed shapes
+    _PROBE_CHUNK = 256
+
+    def _pod_eviction_delta(self, v: Pod) -> Dict[str, np.ndarray]:
+        """Additive per-column delta of hypothetically evicting pod `v`
+        (reverse of its bind contributions). Shapes match the carry columns;
+        computed once per pod per preemption pass (the encoder lookups —
+        match_vector/port_ids/anti_ids — are the expensive part)."""
+        from ..ops.encode import match_vector, resource_scale
+
+        d = {
+            "free": np.zeros(self._carry.free.shape[1], np.float32),
+            "sel": np.zeros(self._carry.sel_counts.shape[0], np.float32),
+            "gpu": np.zeros(self._carry.gpu_free.shape[1], np.float32),
+            "vg": np.zeros(self._carry.vg_free.shape[1], np.float32),
+            "dev": np.zeros(self._carry.dev_free.shape[1], np.float32),
+            "port_any": np.zeros(self._carry.port_any.shape[0], np.float32),
+            "port_wild": np.zeros(self._carry.port_wild.shape[0], np.float32),
+            "port_ipc": np.zeros(self._carry.port_ipc.shape[0], np.float32),
+            "anti": np.zeros(self._carry.anti_counts.shape[0], np.float32),
+        }
+        for res, q in v.requests.items():
+            if res in self.enc.resources:
+                r = self.enc.resources.index(res)
+                d["free"][r] += q / resource_scale(res)
+        d["free"][self.enc.resources.index("pods")] += 1.0
+        vec = match_vector(self.enc, v)
+        m = min(vec.shape[0], d["sel"].shape[0])
+        d["sel"][:m] -= vec[:m]  # evicted pod no longer counts
+        mem = v.gpu_mem_request()
+        if mem > 0:
+            for g in v.gpu_index_ids():
+                if 0 <= g < d["gpu"].shape[0]:
+                    d["gpu"][g] += np.float32(mem / float(1 << 20))
+        takes = self._storage_takes.get(v.key)
+        if takes is not None:
+            d["vg"][: takes[0].shape[0]] += takes[0]
+            d["dev"][: takes[1].shape[0]] += takes[1]
+        for pid, wild, ipid in self.enc.port_ids(v):
+            if pid < d["port_any"].shape[0]:
+                d["port_any"][pid] -= 1.0
+                if wild:
+                    d["port_wild"][pid] -= 1.0
+            if not wild and ipid < d["port_ipc"].shape[0]:
+                d["port_ipc"][ipid] -= 1.0
+        for aid in self.enc.anti_ids(v):
+            if aid < d["anti"].shape[0]:
+                d["anti"][aid] -= 1.0
+        return d
+
+    def _eviction_cols(
+        self, ni: int, on_node, keep_ids, delta_cache: Optional[dict] = None
+    ) -> Dict[str, np.ndarray]:
+        """Node column state with ONLY the kept pods: the current carry column
+        plus the cached eviction delta of every pod not kept. With the shared
+        `delta_cache`, repeated reprieve rounds cost vector adds instead of
+        re-encoding every still-evicted pod (linear, not quadratic, in queue
+        length)."""
+        cols = {
+            "free": np.asarray(self._carry.free[ni]).copy(),
+            "sel": np.asarray(self._carry.sel_counts[:, ni]).copy(),
+            "gpu": np.asarray(self._carry.gpu_free[ni]).copy(),
+            "vg": np.asarray(self._carry.vg_free[ni]).copy(),
+            "dev": np.asarray(self._carry.dev_free[ni]).copy(),
+            "port_any": np.asarray(self._carry.port_any[:, ni]).copy(),
+            "port_wild": np.asarray(self._carry.port_wild[:, ni]).copy(),
+            "port_ipc": np.asarray(self._carry.port_ipc[:, ni]).copy(),
+            "anti": np.asarray(self._carry.anti_counts[:, ni]).copy(),
+        }
+        for v in on_node:
+            if id(v) in keep_ids:
+                continue
+            if delta_cache is not None:
+                d = delta_cache.get(id(v))
+                if d is None:
+                    d = delta_cache[id(v)] = self._pod_eviction_delta(v)
+            else:
+                d = self._pod_eviction_delta(v)
+            for k in cols:
+                cols[k] += d[k]
+        return cols
+
+    def _device_fits_many(self, bound_by_node):
+        """fits_many_fn for lane-parallel victim selection: evaluates ALL
+        candidate (node, remaining-set) states of one reprieve round in a
+        single vmapped device call (chunked at _PROBE_CHUNK lanes), running
+        the REAL filter kernel on each post-eviction column (parity:
         selectVictimsOnNode's dry run of the filter plugins,
-        default_preemption.go:598-626) instead of the resources-only host
-        model. One small device call per (node, victim-set) probe — the
-        preemption path is rare, so the round trips are cheap relative to a
-        wrong victim choice + rollback."""
+        default_preemption.go:598-626, fanned out like its parallel
+        checkNode goroutines :560-576). Replaces one device round trip per
+        (node, victim-set) probe with one per round."""
         import jax
         import jax.numpy as jnp
 
-        from ..ops.encode import encode_pods, match_vector, resource_scale
+        from ..ops.encode import encode_pods
         from ..ops.kernels import run_filters
         from ..ops.state import pod_rows_from_batch
 
-        if not hasattr(self, "_probe_fit_jit"):
+        if not hasattr(self, "_probe_fit_many_jit"):
             extra_filters = self._extra_filters
 
             @jax.jit
-            def probe_fit(ns, carry, row, ni, cols, filter_on):
-                carry2 = carry._replace(
-                    free=carry.free.at[ni].set(cols["free"]),
-                    sel_counts=carry.sel_counts.at[:, ni].set(cols["sel"]),
-                    gpu_free=carry.gpu_free.at[ni].set(cols["gpu"]),
-                    vg_free=carry.vg_free.at[ni].set(cols["vg"]),
-                    dev_free=carry.dev_free.at[ni].set(cols["dev"]),
-                    port_any=carry.port_any.at[:, ni].set(cols["port_any"]),
-                    port_wild=carry.port_wild.at[:, ni].set(cols["port_wild"]),
-                    port_ipc=carry.port_ipc.at[:, ni].set(cols["port_ipc"]),
-                    anti_counts=carry.anti_counts.at[:, ni].set(cols["anti"]),
-                )
-                # same filter set the pod's profile schedules with (mask +
-                # out-of-tree plugins) — a disabled filter must not veto a
-                # node here either
-                mask, _ = run_filters(ns, carry2, row, filter_on, extra_filters)
-                return mask[ni]
+            def probe_many(ns, carry, row, nis, cols, filter_on):
+                def one(ni, col):
+                    carry2 = carry._replace(
+                        free=carry.free.at[ni].set(col["free"]),
+                        sel_counts=carry.sel_counts.at[:, ni].set(col["sel"]),
+                        gpu_free=carry.gpu_free.at[ni].set(col["gpu"]),
+                        vg_free=carry.vg_free.at[ni].set(col["vg"]),
+                        dev_free=carry.dev_free.at[ni].set(col["dev"]),
+                        port_any=carry.port_any.at[:, ni].set(col["port_any"]),
+                        port_wild=carry.port_wild.at[:, ni].set(col["port_wild"]),
+                        port_ipc=carry.port_ipc.at[:, ni].set(col["port_ipc"]),
+                        anti_counts=carry.anti_counts.at[:, ni].set(col["anti"]),
+                    )
+                    # same filter set the pod's profile schedules with (mask
+                    # + out-of-tree plugins) — a disabled filter must not
+                    # veto a node here either
+                    mask, _ = run_filters(
+                        ns, carry2, row, filter_on, extra_filters
+                    )
+                    return mask[ni]
 
-            self._probe_fit_jit = probe_fit
+                return jax.vmap(one)(nis, cols)
+
+            self._probe_fit_many_jit = probe_many
 
         row_cache: Dict[str, object] = {}
+        delta_cache: dict = {}
         name_index = self._name_index_map()
 
-        def fits(pod: Pod, node, remaining) -> bool:
-            ni = name_index[node.name]
+        def fits_many(pod: Pod, items) -> List[bool]:
+            if not items:
+                return []
             prof = self._profiles.get(pod.scheduler_name)
             fo = prof[1] if prof is not None else None
             fo = (
@@ -663,60 +755,45 @@ class Simulator:
                     lambda a: a[0], pod_rows_from_batch(batch)
                 )
                 row_cache[pod.key] = row
-            # Node column with ONLY `remaining` of the node's bound pods:
-            # start from the current carry column and reverse the
-            # contributions of the pods being hypothetically evicted.
-            on_node = bound_by_node.get(node.name, [])
-            keep_ids = {id(p) for p in remaining}
-            cols = {
-                "free": np.asarray(self._carry.free[ni]).copy(),
-                "sel": np.asarray(self._carry.sel_counts[:, ni]).copy(),
-                "gpu": np.asarray(self._carry.gpu_free[ni]).copy(),
-                "vg": np.asarray(self._carry.vg_free[ni]).copy(),
-                "dev": np.asarray(self._carry.dev_free[ni]).copy(),
-                "port_any": np.asarray(self._carry.port_any[:, ni]).copy(),
-                "port_wild": np.asarray(self._carry.port_wild[:, ni]).copy(),
-                "port_ipc": np.asarray(self._carry.port_ipc[:, ni]).copy(),
-                "anti": np.asarray(self._carry.anti_counts[:, ni]).copy(),
-            }
-            for v in on_node:
-                if id(v) in keep_ids:
-                    continue
-                for res, q in v.requests.items():
-                    if res in self.enc.resources:
-                        r = self.enc.resources.index(res)
-                        cols["free"][r] += q / resource_scale(res)
-                cols["free"][self.enc.resources.index("pods")] += 1.0
-                vec = match_vector(self.enc, v)
-                m = min(vec.shape[0], cols["sel"].shape[0])
-                cols["sel"][:m] -= vec[:m]  # evicted pod no longer counts
-                mem = v.gpu_mem_request()
-                if mem > 0:
-                    for d in v.gpu_index_ids():
-                        if 0 <= d < cols["gpu"].shape[0]:
-                            cols["gpu"][d] += np.float32(mem / float(1 << 20))
-                takes = self._storage_takes.get(v.key)
-                if takes is not None:
-                    cols["vg"][: takes[0].shape[0]] += takes[0]
-                    cols["dev"][: takes[1].shape[0]] += takes[1]
-                for pid, wild, ipid in self.enc.port_ids(v):
-                    if pid < cols["port_any"].shape[0]:
-                        cols["port_any"][pid] -= 1.0
-                        if wild:
-                            cols["port_wild"][pid] -= 1.0
-                    if not wild and ipid < cols["port_ipc"].shape[0]:
-                        cols["port_ipc"][ipid] -= 1.0
-                for aid in self.enc.anti_ids(v):
-                    if aid < cols["anti"].shape[0]:
-                        cols["anti"][aid] -= 1.0
-            return bool(
-                self._probe_fit_jit(
-                    self._ns, self._carry, row, ni,
-                    {k: jnp.asarray(v) for k, v in cols.items()}, fo,
+            out: List[bool] = []
+            for start in range(0, len(items), self._PROBE_CHUNK):
+                chunk = items[start : start + self._PROBE_CHUNK]
+                nis = np.array(
+                    [name_index[node.name] for node, _ in chunk], np.int32
                 )
-            )
+                col_list = [
+                    self._eviction_cols(
+                        name_index[node.name],
+                        bound_by_node.get(node.name, []),
+                        {id(p) for p in remaining},
+                        delta_cache,
+                    )
+                    for node, remaining in chunk
+                ]
+                stacked = {
+                    k: np.stack([c[k] for c in col_list])
+                    for k in col_list[0]
+                }
+                # pad the lane axis to a power-of-two bucket so the jit cache
+                # holds a handful of shapes instead of one per lane count
+                c = len(chunk)
+                c_pad = 1 << max(0, (c - 1).bit_length())
+                if c_pad != c:
+                    nis = np.concatenate([nis, np.repeat(nis[:1], c_pad - c)])
+                    stacked = {
+                        k: np.concatenate(
+                            [v, np.repeat(v[:1], c_pad - c, axis=0)]
+                        )
+                        for k, v in stacked.items()
+                    }
+                res = self._probe_fit_many_jit(
+                    self._ns, self._carry, row, jnp.asarray(nis),
+                    {k: jnp.asarray(v) for k, v in stacked.items()}, fo,
+                )
+                out.extend(bool(b) for b in np.asarray(res)[:c])
+            return out
 
-        return fits
+        return fits_many
 
     def _try_preemptions(
         self, failed: List[UnscheduledPod]
@@ -730,7 +807,7 @@ class Simulator:
 
         still_failed: List[UnscheduledPod] = []
         bound_by_node: Optional[Dict[str, List[Pod]]] = None
-        fits_fn = None
+        fits_many_fn = None
         for u in failed:
             pod = u.pod
             if pod.priority <= 0:
@@ -740,10 +817,10 @@ class Simulator:
                 bound_by_node = {}
                 for p, node_name in self._bound:
                     bound_by_node.setdefault(node_name, []).append(p)
-                fits_fn = self._device_fits(bound_by_node)
+                fits_many_fn = self._device_fits_many(bound_by_node)
             res = try_preempt(
                 pod, self.cluster.nodes, bound_by_node, self._pdbs,
-                fits_fn=fits_fn,
+                fits_many_fn=fits_many_fn,
             )
             if res is None or not res.victims:
                 still_failed.append(u)

@@ -107,6 +107,12 @@ def _parse_go_duration(s: str) -> Optional[float]:
 
     if not s:
         return None
+    sign = 1.0
+    if s[0] in "+-":
+        sign = -1.0 if s[0] == "-" else 1.0
+        s = s[1:]
+    if s == "0":
+        return 0.0   # the one unit-less form Go accepts
     total = 0.0
     pos = 0
     seg = _re.compile(r"([\d.]+)(ns|us|µs|ms|s|m|h)")
@@ -119,7 +125,7 @@ def _parse_go_duration(s: str) -> Optional[float]:
         except ValueError:
             return None
         pos = m.end()
-    return total
+    return sign * total if total else 0.0
 
 
 @dataclass

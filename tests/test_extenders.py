@@ -378,3 +378,14 @@ def test_limits_only_managed_resource_is_interesting():
     )
     assert ext.is_interested(limits_only)
     assert not ext.is_interested(plain)
+
+
+def test_zero_and_signed_durations():
+    from open_simulator_tpu.models.profiles import _parse_go_duration
+
+    assert _parse_go_duration("0") == 0.0
+    assert _parse_go_duration("0s") == 0.0
+    assert _parse_go_duration("+5s") == 5.0
+    assert _parse_go_duration("-5s") == -5.0
+    assert _parse_go_duration("1h2m3s") == 3723.0
+    assert _parse_go_duration("x") is None

@@ -8,10 +8,11 @@ PodEligibleToPreemptOthers :231).
 
 import numpy as np
 
-from open_simulator_tpu.core.objects import Node, Pod
+from open_simulator_tpu.core.objects import LabelSelector, Node, Pod
 from open_simulator_tpu.engine.preemption import (
     PodDisruptionBudget,
     PreemptionResult,
+    _fits,
     pick_one_node,
     select_victims_on_node,
     try_preempt,
@@ -193,7 +194,7 @@ def test_end_to_end_no_preemption_for_priorityless_pod():
 
 
 # ---------------------------------------------------------------------------
-# device-filter-backed victim feasibility (Simulator._device_fits)
+# device-filter-backed victim feasibility (Simulator._device_fits_many)
 # ---------------------------------------------------------------------------
 
 def test_device_fits_sees_anti_affinity_where_host_model_cannot():
@@ -311,3 +312,61 @@ def test_device_fits_eviction_clears_anti_affinity_conflict():
         for p in st.pods
     }
     assert placed == {"pre": "solo"}
+
+
+def test_lane_parallel_driver_matches_sequential():
+    """try_preempt with fits_many_fn must pick the same node and victims as
+    the per-node sequential driver — randomized over cluster shapes."""
+    import random
+
+    rng = random.Random(20260730)
+    for trial in range(25):
+        n_nodes = rng.randint(1, 5)
+        nodes = [mknode(f"n{i}", cpu="4") for i in range(n_nodes)]
+        bound_by_node = {}
+        for n in nodes:
+            pods = []
+            for j in range(rng.randint(0, 4)):
+                pods.append(
+                    mkpod(
+                        f"{n.meta.name}-p{j}",
+                        cpu=rng.choice(["500m", "1", "2"]),
+                        priority=rng.choice([0, 1, 5, 50, 1000]),
+                        labels={"grp": rng.choice(["a", "b"])},
+                    )
+                )
+            for p in pods:
+                p.node_name = n.meta.name
+            bound_by_node[n.meta.name] = pods
+        pdbs = []
+        if rng.random() < 0.5:
+            pdbs.append(
+                PodDisruptionBudget(
+                    name="pdb", namespace="default",
+                    selector=LabelSelector.from_dict(
+                        {"matchLabels": {"grp": "a"}}
+                    ),
+                    min_available=str(rng.randint(0, 3)),
+                )
+            )
+        preemptor = mkpod(
+            "pre", cpu=rng.choice(["2", "3", "4"]), priority=100
+        )
+
+        seq = try_preempt(preemptor, nodes, bound_by_node, pdbs)
+
+        def fits_many(pod, items):
+            return [_fits(pod, node, remaining) for node, remaining in items]
+
+        par = try_preempt(
+            preemptor, nodes, bound_by_node, pdbs, fits_many_fn=fits_many
+        )
+        if seq is None:
+            assert par is None, f"trial {trial}"
+        else:
+            assert par is not None, f"trial {trial}"
+            assert par.node == seq.node, f"trial {trial}"
+            assert [v.meta.name for v in par.victims] == [
+                v.meta.name for v in seq.victims
+            ], f"trial {trial}"
+            assert par.num_pdb_violations == seq.num_pdb_violations
