@@ -686,6 +686,12 @@ def main() -> int:
         for name in wanted:
             print(f"bench config {name}...", file=sys.stderr, flush=True)
             configs_out[name] = _run_segment(name, args.pods, args.nodes, platform)
+            # stamp the platform each config ACTUALLY ran on: after a
+            # mid-bench tunnel wedge flips to cpu, individual numbers must
+            # not be mistakable for TPU ones when read in isolation
+            configs_out[name].setdefault(
+                "platform", platform or "(default)"
+            )
             print(
                 f"bench config {name}: {json.dumps(configs_out[name])}",
                 file=sys.stderr, flush=True,

@@ -19,7 +19,10 @@ VERSION = "0.1.0"
 
 
 def _add_apply(sub: argparse._SubParsersAction) -> None:
-    p = sub.add_parser("apply", help="simulate deploying applications")
+    p = sub.add_parser(
+        "apply", help="simulate deploying applications",
+        description="simulate deploying applications",
+    )
     p.add_argument("-f", "--simon-config", required=True, help="path of simon config")
     p.add_argument(
         "--default-scheduler-config", default="",
@@ -59,15 +62,23 @@ def main(argv=None) -> int:
     )
     sub = parser.add_subparsers(dest="command")
     _add_apply(sub)
-    ps = sub.add_parser("server", help="run the REST simulation service")
+    ps = sub.add_parser(
+        "server", help="run the REST simulation service",
+        description="run the REST simulation service",
+    )
     ps.add_argument("--port", type=int, default=9998)
     ps.add_argument(
         "--kubeconfig", default="",
         help="snapshot this cluster per request when the request body carries "
         "no cluster spec",
     )
-    sub.add_parser("version", help="print version")
-    pd = sub.add_parser("gen-doc", help="generate CLI markdown docs")
+    sub.add_parser(
+        "version", help="print version", description="print version"
+    )
+    pd = sub.add_parser(
+        "gen-doc", help="generate CLI markdown docs",
+        description="generate CLI markdown docs",
+    )
     pd.add_argument("--output-dir", default="./docs/commandline")
 
     args = parser.parse_args(argv)
@@ -128,13 +139,44 @@ def main(argv=None) -> int:
 
 
 def _gen_doc(parser: argparse.ArgumentParser, output_dir: str) -> int:
+    """Markdown docs, one file per command like cobra's doc generator
+    (parity: cmd/doc/generate_markdown.go:38 — GenMarkdownTree emits
+    simon.md + simon_<sub>.md with cross-links)."""
     os.makedirs(output_dir, exist_ok=True)
-    path = os.path.join(output_dir, "simon.md")
-    with open(path, "w") as fh:
-        fh.write("# simon\n\n```\n")
-        fh.write(parser.format_help())
-        fh.write("```\n")
-    print(f"wrote {path}")
+    sub_actions = [
+        a for a in parser._actions
+        if isinstance(a, argparse._SubParsersAction)
+    ]
+    commands = dict(sub_actions[0].choices) if sub_actions else {}
+
+    written = []
+    root = os.path.join(output_dir, "simon.md")
+    with open(root, "w") as fh:
+        fh.write("## simon\n\n")
+        fh.write(f"{parser.description}\n\n")
+        fh.write("```\n" + parser.format_help() + "```\n\n")
+        if commands:
+            fh.write("### SEE ALSO\n\n")
+            for name, sp in commands.items():
+                help_line = (sp.description or "").strip()
+                fh.write(
+                    f"* [simon {name}](simon_{name}.md)"
+                    + (f" — {help_line}" if help_line else "")
+                    + "\n"
+                )
+    written.append(root)
+
+    for name, sp in commands.items():
+        path = os.path.join(output_dir, f"simon_{name}.md")
+        with open(path, "w") as fh:
+            fh.write(f"## simon {name}\n\n")
+            if sp.description:
+                fh.write(f"{sp.description}\n\n")
+            fh.write("```\n" + sp.format_help() + "```\n\n")
+            fh.write("### SEE ALSO\n\n* [simon](simon.md)\n")
+        written.append(path)
+    for path in written:
+        print(f"wrote {path}")
     return 0
 
 

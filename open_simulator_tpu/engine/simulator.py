@@ -181,7 +181,12 @@ class Simulator:
         node sets (engine/capacity.plan_capacity). Non-DaemonSet workload
         pods are expanded, patched and validated once, then rebound fresh on
         every reuse; DaemonSet pods stay per-run (their synthesis is
-        per-node). Do not share a cache between different app lists."""
+        per-node). Do not share a cache between different app lists.
+
+        `expand_cache` and `patch_pods` compose only for DaemonSets (patched
+        every run, like the reference patches on every Simulate): non-DS
+        hooks would run once per cache lifetime, silently diverging from
+        WithPatchPodsFuncMap semantics — that combination raises."""
         self.cluster = cluster
         self.use_greed = use_greed
         self.mesh = mesh
@@ -205,6 +210,16 @@ class Simulator:
         # every pod list generated from that workload kind.
         self._patch_pods = dict(patch_pods or {})
         self._expand_cache = expand_cache
+        non_ds_hooks = [k for k in self._patch_pods if k != "DaemonSet"]
+        if expand_cache is not None and non_ds_hooks:
+            # see the docstring: cached expansion would apply these hooks
+            # once per cache lifetime instead of once per Simulate
+            raise ValueError(
+                "expand_cache cannot be combined with patch_pods hooks for "
+                f"{non_ds_hooks}: cached pods are patched once per cache "
+                "lifetime, not once per run (DaemonSet hooks are fine — "
+                "DS pods re-expand every run)"
+            )
         # Apiserver-grade validation before anything schedules: the reference
         # validates every imported node and synthesized pod and fails the
         # whole Simulate on the first invalid object (utils.go:495-508).

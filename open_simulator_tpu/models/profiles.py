@@ -111,6 +111,8 @@ def _parse_go_duration(s: str) -> Optional[float]:
     if s[0] in "+-":
         sign = -1.0 if s[0] == "-" else 1.0
         s = s[1:]
+        if not s:
+            return None   # a bare sign is not a duration
     if s == "0":
         return 0.0   # the one unit-less form Go accepts
     total = 0.0

@@ -67,6 +67,16 @@ FILTER_PLUGIN_MAP = {
     "NodeResourcesFit": F_RESOURCES,
     "PodTopologySpread": F_SPREAD,
     "InterPodAffinity": F_POD_AFFINITY,
+    # The volume filter family of the default provider — VolumeBinding,
+    # VolumeRestrictions, NodeVolumeLimits (EBS/GCE/CSI/Azure), VolumeZone
+    # (vendored algorithmprovider/registry.go:88-106) — is INERT in the
+    # reference and therefore not implemented: MakeValidPod rewrites every
+    # PVC volume to a hostPath volume before any pod reaches the scheduler
+    # (utils.go:378-463, the `vol.PersistentVolumeClaim != nil` branch), so
+    # those filters never see a PVC/bound-volume to act on, and open-local
+    # storage runs through its own plugin instead (ops/kernels.py
+    # local_storage_*). Config files naming them parse cleanly and their
+    # enable/disable is a no-op, matching observable reference behavior.
 }
 
 FILTER_MESSAGES = (

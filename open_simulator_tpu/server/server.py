@@ -91,6 +91,76 @@ def _simulate_request(body: dict) -> dict:
     }
 
 
+def _cpu_profile(seconds: float) -> dict:
+    """Sampling wall-clock profiler over every live thread (the pprof
+    `/debug/pprof/profile?seconds=N` analog): poll sys._current_frames() at
+    ~100 Hz, aggregate identical stacks, report the hottest ones. The
+    sampling thread excludes itself and the serving thread's own frames are
+    visible — exactly like Go's profile including the HTTP handler."""
+    import sys
+    import time
+    import traceback
+    from collections import Counter
+
+    me = threading.get_ident()
+    samples: Counter = Counter()
+    n = 0
+    deadline = time.time() + max(0.1, seconds)
+    while time.time() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            stack = tuple(
+                f"{fs.filename.rsplit('/', 1)[-1]}:{fs.lineno}:{fs.name}"
+                for fs in traceback.extract_stack(frame)[-12:]
+            )
+            samples[stack] += 1
+        n += 1
+        time.sleep(0.01)
+    top = [
+        {"count": c, "stack": list(stack)}
+        for stack, c in samples.most_common(25)
+    ]
+    return {"seconds": seconds, "polls": n, "stacks": top}
+
+
+_tracemalloc_on = False
+
+
+def _heap_profile() -> dict:
+    """Allocation snapshot (the `/debug/pprof/heap` analog): tracemalloc top
+    allocation sites. Tracing starts on the first call — the first snapshot
+    only covers allocations made after it (noted in the payload), matching
+    how pprof heap profiles need the runtime flag enabled."""
+    import tracemalloc
+
+    global _tracemalloc_on
+    first = not _tracemalloc_on
+    if first:
+        tracemalloc.start(10)
+        _tracemalloc_on = True
+    current, peak = tracemalloc.get_traced_memory()
+    snap = tracemalloc.take_snapshot()
+    stats = snap.statistics("lineno")[:25]
+    return {
+        "note": (
+            "tracing just started; snapshot covers allocations from now on"
+            if first
+            else ""
+        ),
+        "traced_current_bytes": current,
+        "traced_peak_bytes": peak,
+        "top": [
+            {
+                "site": str(s.traceback[0]) if s.traceback else "?",
+                "size_bytes": s.size,
+                "count": s.count,
+            }
+            for s in stats
+        ],
+    }
+
+
 class _Handler(BaseHTTPRequestHandler):
     def _send(self, code: int, payload: dict) -> None:
         data = json.dumps(payload).encode()
@@ -104,11 +174,26 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/healthz":
             self._send(200, {"status": "ok"})
         elif self.path == "/debug/timings":
-            # the pprof-analog (server.go:152): recent span trees, see
+            # span trees (server.go:152's pprof registration analog), see
             # utils/tracing.py
             from ..utils.tracing import recent_timings
 
             self._send(200, {"timings": recent_timings()})
+        elif self.path.startswith("/debug/pprof/profile"):
+            # CPU profile: sample every thread's stack at ~100 Hz for
+            # ?seconds=N (default 2; capped), return aggregated stacks —
+            # the wall-clock sampling profile gin-contrib/pprof exposes at
+            # the same path, in text form
+            from urllib.parse import parse_qs, urlparse
+
+            q = parse_qs(urlparse(self.path).query)
+            try:
+                seconds = min(float(q.get("seconds", ["2"])[0]), 30.0)
+            except ValueError:
+                seconds = 2.0
+            self._send(200, _cpu_profile(seconds))
+        elif self.path.startswith("/debug/pprof/heap"):
+            self._send(200, _heap_profile())
         elif self.path == "/test":
             # parity: GET /test returns the literal "test" (server.go:154-156)
             data = b"test"

@@ -328,3 +328,47 @@ def test_unrenderable_chart_degrades_per_app(cfg, tmp_path, monkeypatch):
 
     with pytest.raises(ApplyError):
         build_apps(broken_cfg)
+
+
+def test_server_pprof_endpoints():
+    from open_simulator_tpu.server.server import make_server
+
+    srv = make_server(0)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/pprof/profile?seconds=0.3"
+        ) as r:
+            prof = json.load(r)
+        assert prof["polls"] > 0
+        assert isinstance(prof["stacks"], list)
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/pprof/heap"
+        ) as r:
+            heap1 = json.load(r)
+        assert heap1["note"]  # first call: tracing just started
+        # allocate something measurable, snapshot again
+        blob = ["x" * 1024 for _ in range(1000)]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/pprof/heap"
+        ) as r:
+            heap2 = json.load(r)
+        assert not heap2["note"]
+        assert heap2["traced_current_bytes"] > 0
+        assert heap2["top"]
+        del blob
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        # tracing slows every allocation in this process; turn it back off
+        # so the rest of the suite isn't taxed (a real server keeps it on by
+        # design, like a pprof-enabled runtime)
+        import tracemalloc
+
+        from open_simulator_tpu.server import server as server_mod
+
+        tracemalloc.stop()
+        server_mod._tracemalloc_on = False
