@@ -789,10 +789,14 @@ class Simulator:
                     k: np.stack([c[k] for c in col_list])
                     for k in col_list[0]
                 }
-                # pad the lane axis to a power-of-two bucket so the jit cache
-                # holds a handful of shapes instead of one per lane count
+                # pad the lane axis to a power-of-FOUR bucket (4/16/64/256):
+                # each distinct lane count would otherwise compile its own
+                # vmapped run_filters executable, and the compiles dominate
+                # preemption wall time on cold caches (bench preempt_tiered)
                 c = len(chunk)
-                c_pad = 1 << max(0, (c - 1).bit_length())
+                c_pad = 4
+                while c_pad < c:
+                    c_pad *= 4
                 if c_pad != c:
                     nis = np.concatenate([nis, np.repeat(nis[:1], c_pad - c)])
                     stacked = {

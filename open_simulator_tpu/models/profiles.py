@@ -170,7 +170,7 @@ class ExtenderConfig:
             prioritize_verb=d.get("prioritizeVerb", "") or "",
             preempt_verb=d.get("preemptVerb", "") or "",
             bind_verb=d.get("bindVerb", "") or "",
-            weight=int(d.get("weight") or 1),
+            weight=1 if d.get("weight") is None else int(d["weight"]),
             enable_https=bool(d.get("enableHTTPS")),
             http_timeout_s=seconds,
             node_cache_capable=bool(d.get("nodeCacheCapable")),
@@ -274,6 +274,13 @@ def load_scheduler_config(path: Optional[str]) -> SchedulerConfig:
             raise ValueError(
                 f"{path}: extender {ext.url_prefix}: neither filterVerb nor "
                 "prioritizeVerb set — nothing for the engine to call"
+            )
+        if ext.prioritize_verb and ext.weight <= 0:
+            # kube's component-config validation: a prioritizing extender
+            # must have a positive weight
+            raise ValueError(
+                f"{path}: extender {ext.url_prefix}: prioritizeVerb set "
+                f"with non-positive weight {ext.weight}"
             )
         cfg.extenders.append(ext)
     profiles = doc.get("profiles") or [{}]

@@ -698,12 +698,25 @@ class _Renderer:
                     out.extend(a[k] for k in sorted(a))
             return out
         if fn == "merge":
-            # merge DEST SRC...: later sources fill, earlier win (sprig merge)
-            out2: Dict[str, Any] = {}
-            for a in reversed(args):
+            # sprig merge MUTATES the destination in place (dest keys win,
+            # sources only fill gaps) and returns it — charts rely on the
+            # `{{ $_ := merge .Values.a .Values.b }}` idiom observing the
+            # merge through .Values.a afterwards
+            dest = args[0]
+            if not isinstance(dest, dict):
+                raise ChartError("merge expects a dict destination")
+
+            def fill(dst: Dict[str, Any], src: Dict[str, Any]) -> None:
+                for k, v in src.items():
+                    if k not in dst:
+                        dst[k] = v
+                    elif isinstance(dst[k], dict) and isinstance(v, dict):
+                        fill(dst[k], v)
+
+            for a in args[1:]:
                 if isinstance(a, dict):
-                    out2 = _coalesce(out2, a)
-            return out2
+                    fill(dest, a)
+            return dest
         if fn == "index":
             cur = args[0]
             for key in args[1:]:

@@ -467,3 +467,11 @@ def test_div_mod_truncate_toward_zero():
     assert render_template("{{ mod -7 2 }}", CTX) == "-1"
     assert render_template("{{ div 7 2 }}", CTX) == "3"
     assert render_template("{{ mod 7 -2 }}", CTX) == "1"
+
+
+def test_merge_mutates_destination():
+    # sprig merge is in-place: dest keys win, sources fill gaps, and the
+    # merge is visible through the destination afterwards
+    ctx = {"Values": {"a": {"x": 1, "n": {"k": "keep"}}, "b": {"y": 2, "n": {"k": "lose", "m": 3}}}}
+    src = '{{ $_ := merge .Values.a .Values.b }}{{ .Values.a.y }}/{{ .Values.a.x }}/{{ .Values.a.n.k }}/{{ .Values.a.n.m }}'
+    assert render_template(src, ctx) == "2/1/keep/3"

@@ -389,3 +389,123 @@ def test_zero_and_signed_durations():
     assert _parse_go_duration("-5s") == -5.0
     assert _parse_go_duration("1h2m3s") == 3723.0
     assert _parse_go_duration("x") is None
+
+
+def test_fuzz_extender_path_parity(stub_factory):
+    """Randomized clusters/pods: the per-pod probe->commit path under a
+    pass-through extender must place every workload exactly like the fused
+    batch scan (placement multiset per workload; unscheduled counts)."""
+    import random
+
+    from open_simulator_tpu.core.objects import Node
+
+    stub = stub_factory({})
+    rng = random.Random(42)
+    for trial in range(5):
+        n_nodes = rng.randint(2, 7)
+
+        node_dicts = []
+        for i in range(n_nodes):
+            taints = (
+                [{"key": "ded", "value": "x", "effect": "NoSchedule"}]
+                if rng.random() < 0.25
+                else []
+            )
+            node_dicts.append(
+                {
+                    "metadata": {
+                        "name": f"n{i}",
+                        "labels": {
+                            "kubernetes.io/hostname": f"n{i}",
+                            "zone": f"z{i % 2}",
+                        },
+                    },
+                    "spec": {"taints": taints},
+                    "status": {
+                        "allocatable": {
+                            "cpu": str(rng.choice([4, 8, 16])),
+                            "memory": "32Gi",
+                            "pods": "110",
+                        }
+                    },
+                }
+            )
+
+        def mk_nodes():
+            # both runs must see IDENTICAL clusters (fresh objects, same spec)
+            return [Node.from_dict(d) for d in node_dicts]
+        objects = []
+        for w in range(rng.randint(1, 3)):
+            spec_extra = {}
+            if rng.random() < 0.5:
+                spec_extra["tolerations"] = [
+                    {"key": "ded", "operator": "Exists"}
+                ]
+            if rng.random() < 0.4:
+                spec_extra["topologySpreadConstraints"] = [
+                    {
+                        "maxSkew": 1,
+                        "topologyKey": "zone",
+                        "whenUnsatisfiable": "ScheduleAnyway",
+                        "labelSelector": {
+                            "matchLabels": {"app": f"w{w}"}
+                        },
+                    }
+                ]
+            objects.append(
+                {
+                    "kind": "Deployment",
+                    "metadata": {"name": f"w{w}", "namespace": "f"},
+                    "spec": {
+                        "replicas": rng.randint(1, 6),
+                        "template": {
+                            "metadata": {"labels": {"app": f"w{w}"}},
+                            "spec": {
+                                "containers": [
+                                    {
+                                        "name": "c",
+                                        "image": "i",
+                                        "resources": {
+                                            "requests": {
+                                                "cpu": rng.choice(
+                                                    ["500m", "1", "2"]
+                                                )
+                                            }
+                                        },
+                                    }
+                                ],
+                                **spec_extra,
+                            },
+                        },
+                    },
+                }
+            )
+        apps = [AppResource(name="f", objects=objects)]
+        base = simulate(ClusterResource(nodes=mk_nodes()), apps)
+        ext = simulate(
+            ClusterResource(nodes=mk_nodes()), apps,
+            extenders=[_ext(stub.url)],
+        )
+
+        def key(r):
+            return sorted(
+                (
+                    p.meta.annotations.get("simon/workload-name", ""),
+                    st.node.name,
+                )
+                for st in r.node_status
+                for p in st.pods
+            )
+
+        assert key(base) == key(ext), f"trial {trial}"
+        assert len(base.unscheduled) == len(ext.unscheduled), f"trial {trial}"
+
+
+def test_zero_weight_prioritizer_rejected(tmp_path):
+    bad = tmp_path / "w0.yaml"
+    bad.write_text(
+        "kind: KubeSchedulerConfiguration\nextenders:\n"
+        "  - urlPrefix: http://e\n    prioritizeVerb: p\n    weight: 0\n"
+    )
+    with pytest.raises(ValueError, match="non-positive weight"):
+        load_scheduler_config(str(bad))
