@@ -72,6 +72,11 @@ def main(argv=None) -> int:
         help="snapshot this cluster per request when the request body carries "
         "no cluster spec",
     )
+    ps.add_argument(
+        "--master", default="",
+        help="apiserver URL overriding the kubeconfig's server "
+        "(cmd/server/options.go parity)",
+    )
     sub.add_parser(
         "version", help="print version", description="print version"
     )
@@ -97,7 +102,7 @@ def main(argv=None) -> int:
     if args.command == "server":
         from ..server.server import serve
 
-        return serve(port=args.port, kubeconfig=args.kubeconfig)
+        return serve(port=args.port, kubeconfig=args.kubeconfig, master=args.master)
     if args.command == "apply":
         from ..api.config import SimonConfig
         from ..engine.apply import ApplyError, run_apply

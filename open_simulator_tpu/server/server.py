@@ -38,6 +38,7 @@ from ..utils.yamlio import objects_from_directory
 
 _busy = threading.Lock()
 _kubeconfig: Optional[str] = None  # set by serve()/make_server()
+_master: str = ""                  # apiserver URL override (--master)
 
 
 def _simulate_request(body: dict) -> dict:
@@ -47,10 +48,12 @@ def _simulate_request(body: dict) -> dict:
         cluster = ClusterResource.from_objects(objs)
     elif cluster_spec.get("objects"):
         cluster = ClusterResource.from_objects(list(cluster_spec["objects"]))
-    elif _kubeconfig:
+    elif _kubeconfig or _master:
         from ..utils.kubeclient import create_cluster_resource_from_kubeconfig
 
-        cluster = create_cluster_resource_from_kubeconfig(_kubeconfig)
+        cluster = create_cluster_resource_from_kubeconfig(
+            _kubeconfig or "", master=_master
+        )
     else:
         cluster = ClusterResource.from_objects([])
     for nd in body.get("newNodes") or []:
@@ -233,9 +236,11 @@ def serve(
     port: int = 9998,
     ready: Optional[threading.Event] = None,
     kubeconfig: str = "",
+    master: str = "",
 ) -> int:
-    global _kubeconfig
+    global _kubeconfig, _master
     _kubeconfig = kubeconfig or None
+    _master = master
     httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
     if ready is not None:
         ready.set()

@@ -122,8 +122,15 @@ class KubeClient:
             self._ssl = None
 
     @staticmethod
-    def from_kubeconfig(path: str, context: Optional[str] = None) -> "KubeClient":
-        return KubeClient(load_kubeconfig(path, context))
+    def from_kubeconfig(
+        path: str, context: Optional[str] = None, master: str = ""
+    ) -> "KubeClient":
+        """`master` overrides the kubeconfig's server URL (the reference's
+        --master flag, cmd/server/options.go:14-17 -> BuildConfigFromFlags)."""
+        cfg = load_kubeconfig(path, context)
+        if master:
+            cfg.server = master.rstrip("/")
+        return KubeClient(cfg)
 
     def get(self, api_path: str) -> Dict[str, Any]:
         url = f"{self.cfg.server}{api_path}"
@@ -200,5 +207,17 @@ def snapshot_cluster(client: KubeClient):
     return ClusterResource.from_objects(objs)
 
 
-def create_cluster_resource_from_kubeconfig(path: str, context: Optional[str] = None):
-    return snapshot_cluster(KubeClient.from_kubeconfig(path, context))
+def create_cluster_resource_from_kubeconfig(
+    path: str, context: Optional[str] = None, master: str = ""
+):
+    """Snapshot via a kubeconfig, a kubeconfig + master override, or a bare
+    master URL alone (BuildConfigFromFlags accepts either — an anonymous
+    client with just the apiserver URL is valid against unauthenticated
+    endpoints)."""
+    if path:
+        return snapshot_cluster(KubeClient.from_kubeconfig(path, context, master))
+    if master:
+        return snapshot_cluster(
+            KubeClient(KubeConfig(server=master.rstrip("/")))
+        )
+    raise KubeClientError("neither kubeconfig nor master URL supplied")

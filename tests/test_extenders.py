@@ -509,3 +509,63 @@ def test_zero_weight_prioritizer_rejected(tmp_path):
     )
     with pytest.raises(ValueError, match="non-positive weight"):
         load_scheduler_config(str(bad))
+
+
+def test_preemption_retry_honors_extender_filter(stub_factory):
+    """A preemptor that needs an eviction AND is gated by an extender: the
+    post-eviction retry goes back through the extender path, so the pod may
+    only land on extender-allowed nodes (the reference's retried pod passes
+    findNodesThatPassExtenders again on its next scheduling cycle)."""
+    stub = stub_factory({"allow": {"n1"}})
+    # two 4-cpu nodes, each filled by a 3-cpu low-priority pod; the 3-cpu
+    # high-priority pod must evict — and the extender only allows n1
+    low = {
+        "kind": "Deployment",
+        "metadata": {"name": "low", "namespace": "p"},
+        "spec": {
+            "replicas": 2,
+            "template": {
+                "metadata": {"labels": {"app": "low"}},
+                "spec": {
+                    "containers": [
+                        {"name": "c", "image": "i",
+                         "resources": {"requests": {"cpu": "3"}}}
+                    ]
+                },
+            },
+        },
+    }
+    high = {
+        "kind": "Deployment",
+        "metadata": {"name": "high", "namespace": "p"},
+        "spec": {
+            "replicas": 1,
+            "template": {
+                "metadata": {"labels": {"app": "high"}},
+                "spec": {
+                    "priority": 100,
+                    "containers": [
+                        {"name": "c", "image": "i",
+                         "resources": {"requests": {"cpu": "3"}}}
+                    ],
+                },
+            },
+        },
+    }
+    res = simulate(
+        ClusterResource(nodes=_nodes(2, cpu="4")),
+        [AppResource(name="p", objects=[low, high])],
+        extenders=[_ext(stub.url)],
+    )
+    # the low pods are also extender-gated (only one fits, on n1), so the
+    # high pod's only route is evicting it there — never n0
+    high_nodes = {
+        st.node.name
+        for st in res.node_status
+        for p in st.pods
+        if p.meta.annotations.get("simon/workload-name") == "high"
+    }
+    assert high_nodes <= {"n1"}   # never lands on an extender-denied node
+    assert high_nodes, [
+        (u.pod.meta.name, u.reason) for u in res.unscheduled
+    ]

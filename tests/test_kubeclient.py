@@ -226,3 +226,29 @@ def test_http_error_surfaces(stub_api):
     client = KubeClient(KubeConfig(server=stub_api))
     with pytest.raises(KubeClientError, match="404"):
         client.get("/api/v1/nope")
+
+
+def test_master_overrides_kubeconfig_server(tmp_path):
+    # --master parity (cmd/server/options.go): the URL beats the kubeconfig
+    from open_simulator_tpu.utils.kubeclient import KubeClient
+
+    path = _write_kubeconfig(tmp_path, "https://example:6443")
+    client = KubeClient.from_kubeconfig(path, master="https://override:8443/")
+    assert client.cfg.server == "https://override:8443"
+    # token still comes from the kubeconfig
+    assert client.cfg.token == "sekrit"
+    assert KubeClient.from_kubeconfig(path).cfg.server == "https://example:6443"
+
+
+def test_master_alone_snapshots(stub_api):
+    # BuildConfigFromFlags parity: a bare master URL with no kubeconfig is a
+    # valid (anonymous) client
+    from open_simulator_tpu.utils.kubeclient import (
+        KubeClientError,
+        create_cluster_resource_from_kubeconfig,
+    )
+
+    cluster = create_cluster_resource_from_kubeconfig("", master=stub_api)
+    assert cluster.nodes
+    with pytest.raises(KubeClientError, match="neither kubeconfig nor master"):
+        create_cluster_resource_from_kubeconfig("")
