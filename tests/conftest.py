@@ -20,3 +20,115 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+# ---------------------------------------------------------------------------
+# Shared stub scheduler-extender endpoint (used by test_extenders.py and
+# test_parallel.py) — one copy of the extender wire protocol to keep in sync.
+# ---------------------------------------------------------------------------
+
+import json  # noqa: E402
+import threading  # noqa: E402
+from http.server import BaseHTTPRequestHandler, HTTPServer  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+class _StubExtender:
+    """In-process extender endpoint. `behavior` is a dict:
+    - allow: set of node names the filter keeps (None = keep all)
+    - failed: {node: msg} map returned as FailedNodes
+    - scores: {node: int 0..10} returned by prioritize
+    - error: string returned as ExtenderFilterResult.Error
+    - http_error: int -> respond with that status code
+    Records every request body in .calls."""
+
+    def __init__(self, behavior):
+        self.behavior = behavior
+        self.calls = []
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                stub.calls.append((self.path, body))
+                if stub.behavior.get("http_error"):
+                    self.send_response(stub.behavior["http_error"])
+                    self.end_headers()
+                    return
+                if self.path.endswith("/filter"):
+                    names = body.get("NodeNames")
+                    if names is None:
+                        names = [
+                            (i.get("metadata") or {}).get("name")
+                            for i in (body.get("Nodes") or {}).get("items") or []
+                        ]
+                    allow = stub.behavior.get("allow")
+                    failed = stub.behavior.get("failed") or {}
+                    keep = [
+                        n for n in names
+                        if (allow is None or n in allow) and n not in failed
+                    ]
+                    if body.get("NodeNames") is not None:
+                        resp = {
+                            "NodeNames": keep,
+                            "FailedNodes": failed,
+                            "Error": stub.behavior.get("error", ""),
+                        }
+                    else:
+                        resp = {
+                            "Nodes": {
+                                "items": [
+                                    {"metadata": {"name": n}} for n in keep
+                                ]
+                            },
+                            "FailedNodes": failed,
+                            "Error": stub.behavior.get("error", ""),
+                        }
+                else:  # prioritize
+                    names = body.get("NodeNames")
+                    if names is None:
+                        names = [
+                            (i.get("metadata") or {}).get("name")
+                            for i in (body.get("Nodes") or {}).get("items") or []
+                        ]
+                    scores = stub.behavior.get("scores") or {}
+                    resp = [
+                        {"Host": n, "Score": int(scores.get(n, 0))}
+                        for n in names
+                    ]
+                out = json.dumps(resp).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+        self.server = HTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self.thread.start()
+        self.url = f"http://127.0.0.1:{self.server.server_address[1]}/ext"
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture
+def stub_factory():
+    stubs = []
+
+    def make(behavior):
+        s = _StubExtender(behavior)
+        stubs.append(s)
+        return s
+
+    yield make
+    for s in stubs:
+        s.close()

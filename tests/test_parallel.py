@@ -181,3 +181,83 @@ def test_fast_path_under_mesh_matches_single_device():
             np.asarray(getattr(carry_out, name)),
             err_msg=f"carry field {name}",
         )
+
+
+def test_extender_path_under_mesh(stub_factory):
+    """The per-pod extender path (probe_step/commit_step) compiles and runs
+    under GSPMD node-axis sharding, matching the single-device run with the
+    same pass-through extender."""
+    from open_simulator_tpu.core.objects import Node
+    from open_simulator_tpu.core.workloads import reset_name_rng
+    from open_simulator_tpu.engine.simulator import (
+        AppResource,
+        ClusterResource,
+        simulate,
+    )
+    from open_simulator_tpu.models.profiles import ExtenderConfig
+    from open_simulator_tpu.parallel.mesh import product_mesh
+
+    stub = stub_factory({})   # pass-through: keep all, score 0
+    ext = [
+        ExtenderConfig(
+            url_prefix=stub.url,
+            filter_verb="filter", prioritize_verb="prioritize",
+        )
+    ]
+
+    def nodes():
+        return [
+            Node.from_dict(
+                {
+                    "metadata": {
+                        "name": f"m{i}",
+                        "labels": {"kubernetes.io/hostname": f"m{i}"},
+                    },
+                    "status": {
+                        "allocatable": {
+                            "cpu": "8", "memory": "16Gi", "pods": "110"
+                        }
+                    },
+                }
+            )
+            for i in range(16)
+        ]
+
+    app = AppResource(
+        name="m",
+        objects=[
+            {
+                "kind": "Deployment",
+                "metadata": {"name": "w", "namespace": "m"},
+                "spec": {
+                    "replicas": 6,
+                    "template": {
+                        "metadata": {"labels": {"app": "w"}},
+                        "spec": {
+                            "containers": [
+                                {"name": "c", "image": "i",
+                                 "resources": {"requests": {"cpu": "2"}}}
+                            ]
+                        },
+                    },
+                },
+            }
+        ],
+    )
+    reset_name_rng()
+    single = simulate(ClusterResource(nodes=nodes()), [app], extenders=ext)
+    reset_name_rng()
+    sharded = simulate(
+        ClusterResource(nodes=nodes()), [app], extenders=ext,
+        mesh=product_mesh(8),
+    )
+
+    def key(r):
+        return sorted(
+            (p.key, st.node.name)
+            for st in r.node_status
+            for p in st.pods
+        )
+
+    assert key(single) == key(sharded)
+    assert not single.unscheduled and not sharded.unscheduled
