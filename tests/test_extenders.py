@@ -8,7 +8,6 @@ and the extender score fold (generic_scheduler.go:521-555, × weight ×
 MaxNodeScore/MaxExtenderPriority).
 """
 
-import json
 
 import pytest
 
