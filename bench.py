@@ -488,6 +488,10 @@ CONFIGS = {
 }
 
 
+def _fmt_count(n: int) -> str:
+    return f"{n // 1000}k" if n >= 1000 else str(n)
+
+
 def _run_headline(pods: int, nodes: int) -> dict:
     """The headline kernel benchmark, in-process (called in a child)."""
     import jax
@@ -514,7 +518,7 @@ def _run_headline(pods: int, nodes: int) -> dict:
     scheduled = int((placed >= 0).sum())
     pods_per_sec = pods / run
     return {
-        "metric": f"schedule_{pods//1000}k_pods_{nodes//1000}k_nodes",
+        "metric": f"schedule_{_fmt_count(pods)}_pods_{_fmt_count(nodes)}_nodes",
         "value": round(pods_per_sec, 1),
         "unit": "pods/s",
         "vs_baseline": round(pods_per_sec / TARGET_PODS_PER_SEC, 3),

@@ -265,7 +265,14 @@ def run_apply(
         report += "\n" + "\n".join(
             f"FAILED APP {fa.name}: {fa.error}" for fa in failed_apps
         )
-    print(report, file=out)
+    # color only live terminal output (pterm/DisablePTerm parity): the
+    # returned ApplyOutcome.report and --output-file stay plain text
+    display = report
+    if not report_to_file and getattr(out, "isatty", lambda: False)():
+        from ..utils.tables import colorize_report
+
+        display = colorize_report(report)
+    print(display, file=out)
     return ApplyOutcome(
         result=result, plan=plan, report=report, failed_apps=failed_apps
     )

@@ -372,3 +372,33 @@ def test_server_pprof_endpoints():
 
         tracemalloc.stop()
         server_mod._tracemalloc_on = False
+
+
+def test_report_colorization(cfg, monkeypatch):
+    from open_simulator_tpu.utils.tables import colorize_report
+
+    plain = "=== Cluster ===\n| n | 8.1% | 55.0% | 95.0% |"
+    colored = colorize_report(plain)
+    assert "\x1b[1m=== Cluster ===\x1b[0m" in colored
+    assert "\x1b[32m8.1%\x1b[0m" in colored     # green < 50
+    assert "\x1b[33m55.0%\x1b[0m" in colored    # yellow < 80
+    assert "\x1b[31m95.0%\x1b[0m" in colored    # red >= 80
+
+    # the real isatty gate: a tty-like stdout gets colors...
+    import io
+    import sys
+
+    class _TtyOut(io.StringIO):
+        def isatty(self):
+            return True
+
+    tty = _TtyOut()
+    monkeypatch.setattr(sys, "stdout", tty)
+    outcome = run_apply(cfg, auto_plan=False)   # out=None -> sys.stdout
+    assert "\x1b[1m=== Cluster ===\x1b[0m" in tty.getvalue()
+    # ...while the returned report and non-tty output stay plain
+    assert "\x1b[" not in outcome.report
+    monkeypatch.undo()
+    out = io.StringIO()
+    outcome2 = run_apply(cfg, auto_plan=False, out=out)
+    assert "\x1b[" not in out.getvalue()
