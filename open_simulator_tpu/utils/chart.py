@@ -72,6 +72,9 @@ class Chart:
     values: Dict[str, Any]
     templates: Dict[str, str]            # relative path -> text
     dependencies: List["Chart"] = field(default_factory=list)
+    # non-template chart files (.Files): relative path -> bytes. Helm
+    # excludes templates/, charts/, Chart.yaml and values.yaml.
+    files: Dict[str, bytes] = field(default_factory=dict)
 
 
 def load_chart(path: str) -> Chart:
@@ -107,6 +110,47 @@ def load_chart(path: str) -> Chart:
     except (OSError, UnicodeDecodeError, yaml.YAMLError) as e:
         # surface as ChartError so the apply layer records a per-app failure
         raise ChartError(f"unreadable chart {path}: {e}")
+
+
+def _load_helmignore(path: str):
+    """Parse .helmignore (gitignore-like: comments, blank lines, trailing
+    '/' for directories, '!' negation; patterns without '/' match basenames
+    at any depth). Returns [(regex, negate, dir_only)]."""
+    rules = []
+    p = os.path.join(path, ".helmignore")
+    if not os.path.exists(p):
+        return rules
+    with open(p) as fh:
+        for raw in fh:
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            negate = line.startswith("!")
+            if negate:
+                line = line[1:]
+            dir_only = line.endswith("/")
+            line = line.rstrip("/")
+            if not line:
+                continue
+            if "/" in line:
+                rx = _glob_regex(line.lstrip("/"))
+            else:
+                # basename pattern: match at any depth
+                rx = re.compile(
+                    "^(?:.*/)?" + _glob_regex(line).pattern[1:]
+                )
+            rules.append((rx, negate, dir_only))
+    return rules
+
+
+def _helmignored(rel: str, rules, is_dir: bool) -> bool:
+    ignored = False
+    for rx, negate, dir_only in rules:
+        if dir_only and not is_dir:
+            continue
+        if rx.match(rel):
+            ignored = not negate
+    return ignored
 
 
 def _load_chart_dir(path: str) -> Chart:
@@ -147,10 +191,37 @@ def _load_chart_dir(path: str) -> Chart:
             if os.path.isdir(sub) or entry.endswith(".tgz"):
                 deps.append(load_chart(sub))
 
+    # .Files: everything but templates/, charts/, the chart metadata, and
+    # whatever .helmignore excludes (Helm's loader filters those before the
+    # engine ever sees them)
+    ignore = _load_helmignore(path)
+    files: Dict[str, bytes] = {}
+    for root, dirs, names in os.walk(path):
+        rel_root = os.path.relpath(root, path)
+        if rel_root == ".":
+            dirs[:] = [d for d in dirs if d not in ("templates", "charts")]
+        dirs[:] = [
+            d
+            for d in dirs
+            if not _helmignored(
+                os.path.normpath(os.path.join(rel_root, d)).replace(os.sep, "/"),
+                ignore, is_dir=True,
+            )
+        ]
+        for f in sorted(names):
+            rel = os.path.normpath(os.path.join(rel_root, f)).replace(os.sep, "/")
+            if rel in ("Chart.yaml", "values.yaml", "Chart.lock",
+                       ".helmignore"):
+                continue
+            if _helmignored(rel, ignore, is_dir=False):
+                continue
+            with open(os.path.join(root, f), "rb") as fh:
+                files[rel] = fh.read()
+
     name = metadata.get("name") or os.path.basename(path.rstrip("/"))
     return Chart(
         name=name, metadata=metadata, values=values, templates=templates,
-        dependencies=deps,
+        dependencies=deps, files=files,
     )
 
 
@@ -462,6 +533,19 @@ class _Renderer:
             if piped is not _NOPIPE:
                 args.append(piped)
             return self._call(head, args, dot, scope), i
+        def finish(value: Any) -> Any:
+            """Resolve a terminal command value: Go auto-invokes niladic
+            methods, and a piped-in value becomes the method's argument
+            (`"f.txt" | .Files.Get`). Piping into a non-callable errors."""
+            if callable(value):
+                try:
+                    return value(piped) if piped is not _NOPIPE else value()
+                except TypeError as e:
+                    raise ChartError(f"template method call failed: {e}")
+            if piped is not _NOPIPE:
+                raise ChartError(f"cannot pipe into non-function {head!r}")
+            return value
+
         if len(parts) > 1:
             # method invocation: .Capabilities.APIVersions.Has "apps/v1"
             target = resolve(parts[0])
@@ -470,13 +554,22 @@ class _Renderer:
                 if piped is not _NOPIPE:
                     args.append(piped)
                 return target(*args), i
+            if (
+                parts[0][0] == "val"   # ONLY a parenthesized result — a
+                                       # plain `.a .b` stays an error like Go
+                and len(parts) == 2
+                and parts[1][0] == "tok"
+                and parts[1][1].startswith(".")
+            ):
+                # field/method access on a parenthesized result:
+                # (.Files.Glob "x").AsConfig
+                return finish(
+                    self._navigate(target, parts[1][1].strip(".").split("."))
+                ), i
             raise ChartError(
                 f"unsupported template expression: {' '.join(str(p[1]) for p in parts)!r}"
             )
-        value = resolve(parts[0])
-        if piped is not _NOPIPE:
-            raise ChartError(f"cannot pipe into non-function {head!r}")
-        return value, i
+        return finish(resolve(parts[0])), i
 
     # -- named templates ----------------------------------------------------
     def exec_template(self, name: str, dot: Any) -> str:
@@ -616,6 +709,14 @@ class _Renderer:
         if fn == "split":
             parts = _to_string(args[1]).split(_to_string(args[0]))
             return {f"_{i}": p for i, p in enumerate(parts)}
+        if fn == "base":
+            return _go_path_base(_to_string(args[0]))
+        if fn == "dir":
+            return _go_path_dir(_to_string(args[0]))
+        if fn == "ext":
+            return _go_path_ext(_to_string(args[0]))
+        if fn == "clean":
+            return posixpath.normpath(_to_string(args[0])) if args[0] else "."
         if fn == "sha256sum":
             return hashlib.sha256(_to_string(args[0]).encode()).hexdigest()
         if fn == "b64enc":
@@ -924,7 +1025,13 @@ class _Renderer:
             expr = m.group(3)
         coll = self._eval(expr, dot, scope)
         pairs: List[Tuple[Any, Any]]   # (key-or-index, element)
-        if isinstance(coll, dict):
+        if isinstance(coll, _Files):
+            # range over .Files / .Files.Glob yields (path, content)
+            pairs = [
+                (k, coll._files[k].decode(errors="replace"))
+                for k in sorted(coll._files)
+            ]
+        elif isinstance(coll, dict):
             # Go templates visit maps in sorted key order
             pairs = [(k, coll[k]) for k in sorted(coll, key=_to_string)]
         elif isinstance(coll, (list, tuple)):
@@ -963,6 +1070,64 @@ def _collect_defines(nodes: List[_Node], registry: Dict[str, List[_Node]]) -> No
             _collect_defines(body, registry)
         if n.else_body:
             _collect_defines(n.else_body, registry)
+
+
+# Go `path` package semantics (sprig's base/dir/ext delegate to it), which
+# differ from posixpath on edge inputs: Base("")=".", Base("a/")="a",
+# Dir("a")=".", Ext(".bashrc")=".bashrc".
+
+def _go_path_base(s: str) -> str:
+    if not s:
+        return "."
+    s = s.rstrip("/")
+    if not s:
+        return "/"
+    return s.rsplit("/", 1)[-1]
+
+
+def _go_path_dir(s: str) -> str:
+    if not s:
+        return "."
+    d = posixpath.dirname(s)
+    if not d:
+        return "/" if s.startswith("/") else "."
+    return posixpath.normpath(d)
+
+
+def _go_path_ext(s: str) -> str:
+    dot = s.rfind(".")
+    return s[dot:] if dot > s.rfind("/") else ""
+
+
+def _glob_regex(pat: str):
+    """Helm's Files.Glob semantics (gobwas/glob compiled with '/' as the
+    separator): `*`/`?` do not cross path segments, `**` does."""
+    out = []
+    i = 0
+    while i < len(pat):
+        c = pat[i]
+        if c == "*":
+            if pat[i : i + 2] == "**":
+                out.append(".*")
+                i += 2
+            else:
+                out.append("[^/]*")
+                i += 1
+        elif c == "?":
+            out.append("[^/]")
+            i += 1
+        elif c == "[":
+            j = pat.find("]", i + 1)
+            if j == -1:
+                out.append(re.escape(c))
+                i += 1
+            else:
+                out.append(pat[i : j + 1])
+                i = j + 1
+        else:
+            out.append(re.escape(c))
+            i += 1
+    return re.compile("^" + "".join(out) + "$")
 
 
 def _go_kind(v: Any) -> str:
@@ -1099,6 +1264,51 @@ def _coalesce(base: Dict[str, Any], overlay: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+class _Files:
+    """`.Files` (helm.sh/helm/v3/pkg/engine files.go): access to the chart's
+    non-template files from templates. Method surface matches Helm's —
+    Get/GetBytes/Glob/Lines/AsConfig/AsSecrets."""
+
+    __template_safe__ = (
+        "Get", "GetBytes", "Glob", "Lines", "AsConfig", "AsSecrets",
+    )
+
+    def __init__(self, files: Dict[str, bytes]):
+        self._files = dict(files)
+
+    def Get(self, name: Any) -> str:                 # noqa: N802
+        data = self._files.get(_to_string(name))
+        return data.decode(errors="replace") if data is not None else ""
+
+    def GetBytes(self, name: Any) -> bytes:          # noqa: N802
+        return self._files.get(_to_string(name), b"")
+
+    def Glob(self, pattern: Any) -> "_Files":        # noqa: N802
+        rx = _glob_regex(_to_string(pattern))
+        return _Files(
+            {k: v for k, v in self._files.items() if rx.match(k)}
+        )
+
+    def Lines(self, name: Any) -> List[str]:         # noqa: N802
+        text = self.Get(name)
+        return text.splitlines() if text else []
+
+    def AsConfig(self) -> str:                       # noqa: N802
+        """Basename -> file content, as YAML (for `data:` of a ConfigMap)."""
+        out = {
+            posixpath.basename(k): v.decode(errors="replace")
+            for k, v in sorted(self._files.items())
+        }
+        return yaml.safe_dump(out, default_flow_style=False).rstrip("\n") if out else ""
+
+    def AsSecrets(self) -> str:                      # noqa: N802
+        out = {
+            posixpath.basename(k): base64.b64encode(v).decode()
+            for k, v in sorted(self._files.items())
+        }
+        return yaml.safe_dump(out, default_flow_style=False).rstrip("\n") if out else ""
+
+
 class _APIVersions(list):
     """`.Capabilities.APIVersions` with the `.Has` method templates call."""
 
@@ -1176,6 +1386,7 @@ def _render_parsed(
         },
         "Values": values,
         "Capabilities": _CAPABILITIES,
+        "Files": _Files(chart.files),
     }
     files: Dict[str, str] = {}
     for rel, nodes in parsed_by_chart.get(id(chart), []):

@@ -475,3 +475,128 @@ def test_merge_mutates_destination():
     ctx = {"Values": {"a": {"x": 1, "n": {"k": "keep"}}, "b": {"y": 2, "n": {"k": "lose", "m": 3}}}}
     src = '{{ $_ := merge .Values.a .Values.b }}{{ .Values.a.y }}/{{ .Values.a.x }}/{{ .Values.a.n.k }}/{{ .Values.a.n.m }}'
     assert render_template(src, ctx) == "2/1/keep/3"
+
+
+def test_files_access(tmp_path):
+    """.Files parity (helm engine files.go): Get / Glob / Lines / AsConfig /
+    AsSecrets over the chart's non-template files."""
+    cdir = _write_chart(
+        tmp_path,
+        templates={
+            "cm.yaml": textwrap.dedent(
+                """\
+                apiVersion: v1
+                kind: ConfigMap
+                metadata:
+                  name: files-cm
+                data:
+                  one: {{ .Files.Get "config/one.conf" | quote }}
+                  lines: {{ .Files.Lines "config/two.conf" | len }}
+                  {{- range $path, $content := .Files.Glob "config/*.conf" }}
+                  glob-{{ base $path }}: {{ $content | quote }}
+                  {{- end }}
+                """
+            ),
+            "cm2.yaml": textwrap.dedent(
+                """\
+                apiVersion: v1
+                kind: ConfigMap
+                metadata:
+                  name: asconfig-cm
+                data:
+                  {{- (.Files.Glob "config/*").AsConfig | nindent 2 }}
+                """
+            ),
+            "secret.yaml": textwrap.dedent(
+                """\
+                apiVersion: v1
+                kind: Secret
+                metadata:
+                  name: files-secret
+                data:
+                  {{- (.Files.Glob "config/one.conf").AsSecrets | nindent 2 }}
+                """
+            ),
+        },
+    )
+    os.makedirs(os.path.join(cdir, "config"))
+    with open(os.path.join(cdir, "config", "one.conf"), "w") as fh:
+        fh.write("a=1")            # single line: YAML-safe through `quote`
+    with open(os.path.join(cdir, "config", "two.conf"), "w") as fh:
+        fh.write("x=9\ny=8")       # multi line: carried via AsConfig/Lines
+
+    objs = process_chart(cdir)
+    cm = next(o for o in objs if o["metadata"]["name"] == "files-cm")
+    assert cm["data"]["one"] == "a=1"
+    assert cm["data"]["lines"] == 2
+    assert cm["data"]["glob-one.conf"] == "a=1"
+    cm2 = next(o for o in objs if o["metadata"]["name"] == "asconfig-cm")
+    assert cm2["data"] == {"one.conf": "a=1", "two.conf": "x=9\ny=8"}
+    sec = next(o for o in objs if o["metadata"]["name"] == "files-secret")
+    import base64 as b64
+
+    assert b64.b64decode(sec["data"]["one.conf"]).decode() == "a=1"
+    # Chart.yaml / values.yaml / templates are not Files
+    from open_simulator_tpu.utils.chart import load_chart
+
+    chart = load_chart(cdir)
+    assert set(chart.files) == {"config/one.conf", "config/two.conf"}
+
+
+def test_files_glob_segment_semantics_and_helmignore(tmp_path):
+    cdir = _write_chart(
+        tmp_path,
+        templates={"cm.yaml": "kind: ConfigMap\nmetadata:\n  name: x\n"},
+    )
+    os.makedirs(os.path.join(cdir, "config", "sub"))
+    for rel, content in [
+        ("config/one.conf", "1"),
+        ("config/sub/deep.conf", "2"),
+        ("README.md", "docs"),
+        ("notes.txt", "n"),
+    ]:
+        with open(os.path.join(cdir, rel), "w") as fh:
+            fh.write(content)
+    with open(os.path.join(cdir, ".helmignore"), "w") as fh:
+        fh.write("# comment\n*.md\n")
+
+    from open_simulator_tpu.utils.chart import load_chart
+
+    chart = load_chart(cdir)
+    # .helmignore filters *.md; .helmignore itself is never a File
+    assert set(chart.files) == {
+        "config/one.conf", "config/sub/deep.conf", "notes.txt"
+    }
+    files_ctx = {"Files": None}
+    from open_simulator_tpu.utils.chart import _Files
+
+    f = _Files(chart.files)
+    # '*' does not cross '/' (gobwas glob with separator); '**' does
+    assert set(f.Glob("config/*.conf")._files) == {"config/one.conf"}
+    assert set(f.Glob("config/**.conf")._files) == {
+        "config/one.conf", "config/sub/deep.conf"
+    }
+
+
+def test_go_path_functions():
+    assert render_template('{{ base "a/b.txt" }}', CTX) == "b.txt"
+    assert render_template('{{ base "a/" }}', CTX) == "a"
+    assert render_template('{{ base "" }}', CTX) == "."
+    assert render_template('{{ dir "a/b.txt" }}', CTX) == "a"
+    assert render_template('{{ dir "a" }}', CTX) == "."
+    assert render_template('{{ ext ".bashrc" }}', CTX) == ".bashrc"
+    assert render_template('{{ ext "a/b.txt" }}', CTX) == ".txt"
+    assert render_template('{{ ext "a/b" }}', CTX) == ""
+
+
+def test_method_pipe_and_field_access_guards():
+    from open_simulator_tpu.utils.chart import _Files
+
+    ctx = dict(CTX)
+    ctx["Files"] = _Files({"f.txt": b"hi"})
+    # piping into a method passes the piped value as the argument
+    assert render_template('{{ "f.txt" | .Files.Get }}', ctx) == "hi"
+    # a value argument to a non-function is still an error (Go semantics),
+    # not silent field navigation
+    with pytest.raises(ChartError):
+        render_template("{{ .Values.nested .image }}", ctx)
