@@ -88,20 +88,29 @@ def _rand_tmpl(rng, t):
 
 def _seeds():
     """CI keeps 3 representative seeds; OSIM_FUZZ_SEEDS widens the sweep for
-    soaks, e.g. OSIM_FUZZ_SEEDS=100-139 (range) or =5,8,13 (list). The
-    round-4 soak ran seeds 100-139 (80 cases): all bit-identical."""
+    soaks, e.g. OSIM_FUZZ_SEEDS=100-139 (range) or =5,8,13 (list); each seed
+    runs 3 generator rounds. The round-4 soak covered seeds 100-139 (40
+    fresh seeds): every case bit-identical to the oracle."""
     base = [3, 17, 29]
     extra = os.environ.get("OSIM_FUZZ_SEEDS", "")
     if not extra:
         return base
     out = []
-    for part in extra.split(","):
-        part = part.strip()
-        if "-" in part:
-            lo, hi = part.split("-", 1)
-            out.extend(range(int(lo), int(hi) + 1))
-        elif part:
-            out.append(int(part))
+    try:
+        for part in extra.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "-" in part:
+                lo, hi = part.split("-", 1)
+                out.extend(range(int(lo), int(hi) + 1))
+            else:
+                out.append(int(part))
+    except ValueError:
+        raise ValueError(
+            f"OSIM_FUZZ_SEEDS={extra!r}: expected comma-separated ints "
+            "or lo-hi ranges (e.g. 100-139 or 5,8,13)"
+        )
     return base + out
 
 
