@@ -103,6 +103,8 @@ def _seeds():
                 continue
             if "-" in part:
                 lo, hi = part.split("-", 1)
+                if int(lo) > int(hi):
+                    raise ValueError(f"reversed range {part!r}")
                 out.extend(range(int(lo), int(hi) + 1))
             else:
                 out.append(int(part))

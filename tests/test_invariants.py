@@ -1,0 +1,190 @@
+"""Engine-level invariant fuzz over the full simulate() API.
+
+The kernel-level fuzz (test_fuzz_parity.py) proves the fast paths equal the
+sequential oracle; this layer checks what no kernel oracle can — that the
+END-TO-END engine (workload expansion, ordering, device state bookkeeping,
+preemption eviction/rollback accounting) never produces a physically
+invalid result. Checked with the pure-Python predicates in core/matcher.py
+(the reference's validation logic re-derived), against randomized clusters
+mixing priorities, PDBs, taints, selectors and anti-affinity:
+
+  1. conservation: placed + unscheduled == expected per workload
+  2. no overcommit: per-node cpu/mem/pod-count within allocatable
+  3. placement legality: every placed pod tolerates its node's NoSchedule
+     taints and matches its own nodeSelector
+  4. eviction accounting: preempted pods are unbound (and never double-
+     counted in node usage), every preemptor is placed or honestly failed
+"""
+
+import random
+
+from open_simulator_tpu.core.matcher import (
+    match_node_affinity,
+    untolerated_taint,
+)
+from open_simulator_tpu.core.workloads import expected_pod_counts
+from open_simulator_tpu.engine.simulator import (
+    AppResource,
+    ClusterResource,
+    simulate,
+)
+from tests.factories import (
+    make_daemonset,
+    make_deployment,
+    make_job,
+    make_node,
+    make_statefulset,
+    taint,
+    toleration,
+)
+
+
+def _rand_cluster(rng):
+    nodes = []
+    for i in range(rng.randint(2, 8)):
+        labels = {}
+        if rng.random() < 0.5:
+            labels["pool"] = rng.choice(["a", "b"])
+        nodes.append(
+            make_node(
+                f"n{i}",
+                cpu=str(rng.choice([2, 4, 8])),
+                memory=f"{rng.choice([4, 8, 16])}Gi",
+                pods=str(rng.choice([5, 110])),
+                with_labels=labels,
+                with_taints=(
+                    [taint("ded", "x")] if rng.random() < 0.3 else None
+                ),
+            )
+        )
+    return nodes
+
+
+def _rand_workloads(rng, n):
+    objs = []
+    for w in range(n):
+        opts = dict(
+            cpu=rng.choice(["250m", "500m", "1", "2"]),
+            memory=rng.choice(["256Mi", "1Gi"]),
+            namespace="inv",
+        )
+        if rng.random() < 0.4:
+            opts["with_tolerations"] = [toleration("ded", operator="Exists")]
+        if rng.random() < 0.3:
+            opts["with_node_selector"] = {"pool": rng.choice(["a", "b"])}
+        if rng.random() < 0.3:
+            opts["with_priority"] = rng.choice([0, 10, 100])
+        if rng.random() < 0.2:
+            opts["with_affinity"] = {
+                "podAntiAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [
+                        {
+                            "labelSelector": {
+                                "matchLabels": {"app": f"w{w}"}
+                            },
+                            "topologyKey": "kubernetes.io/hostname",
+                        }
+                    ]
+                }
+            }
+        kind = rng.choice(["Deployment", "StatefulSet", "Job"])
+        if kind == "Deployment":
+            objs.append(
+                make_deployment(f"w{w}", replicas=rng.randint(1, 6), **opts)
+            )
+        elif kind == "StatefulSet":
+            objs.append(
+                make_statefulset(f"w{w}", replicas=rng.randint(1, 6), **opts)
+            )
+        else:
+            objs.append(
+                make_job(
+                    f"w{w}", completions=rng.randint(1, 6), parallelism=2,
+                    **opts,
+                )
+            )
+    pdbs = []
+    if rng.random() < 0.4:
+        pdbs.append(
+            {
+                "kind": "PodDisruptionBudget",
+                "metadata": {"name": "pdb", "namespace": "inv"},
+                "spec": {
+                    "minAvailable": rng.randint(0, 2),
+                    "selector": {"matchLabels": {"app": "w0"}},
+                },
+            }
+        )
+    return objs, pdbs
+
+
+def _check_invariants(cluster, objs, result):
+    # 1. conservation — preempted victims are DELETED from the cluster
+    # (the reference's PrepareCandidate deletes them), so they account for
+    # the gap between expected and placed+unscheduled
+    expected = expected_pod_counts(objs + cluster.daemonsets, cluster.nodes)
+    placed = sum(len(st.pods) for st in result.node_status)
+    assert placed + len(result.unscheduled) + len(result.preempted) == sum(
+        expected.values()
+    ), (placed, len(result.unscheduled), len(result.preempted), expected)
+
+    node_by_name = {n.name: n for n in cluster.nodes}
+    placed_keys = set()
+    for st in result.node_status:
+        node = st.node
+        cpu = mem = 0
+        for p in st.pods:
+            assert p.node_name == node.name and p.phase == "Running"
+            assert p.key not in placed_keys, f"double-bound {p.key}"
+            placed_keys.add(p.key)
+            cpu += p.requests.get("cpu", 0)
+            mem += p.requests.get("memory", 0)
+            # 3. placement legality
+            taint = untolerated_taint(p.tolerations, node)
+            assert taint is None or taint.effect != "NoSchedule", (
+                f"{p.key} on {node.name} despite taint {taint}"
+            )
+            for k, v in p.node_selector.items():
+                assert node.meta.labels.get(k) == v, (
+                    f"{p.key}: selector {k}={v} vs {node.meta.labels}"
+                )
+            assert match_node_affinity(p, node), f"{p.key} affinity"
+        # 2. no overcommit
+        assert cpu <= node.allocatable.get("cpu", 0), (node.name, "cpu")
+        assert mem <= node.allocatable.get("memory", 0), (node.name, "mem")
+        assert len(st.pods) <= node.allocatable.get("pods", 1 << 30)
+
+    # 4. eviction accounting
+    for pre in result.preempted:
+        assert pre.pod.key not in placed_keys, (
+            f"preempted {pre.pod.key} still bound"
+        )
+        assert pre.pod.node_name == "" and pre.pod.phase == "Pending"
+    unsched_keys = {u.pod.key for u in result.unscheduled}
+    assert not (unsched_keys & placed_keys)
+
+
+def test_engine_invariants_randomized():
+    rng = random.Random(20260730)
+    for trial in range(12):
+        nodes = _rand_cluster(rng)
+        objs, pdbs = _rand_workloads(rng, rng.randint(1, 4))
+        cluster = ClusterResource(
+            nodes=nodes, others={"PodDisruptionBudget": pdbs}
+        )
+        result = simulate(cluster, [AppResource(name="inv", objects=objs)])
+        _check_invariants(cluster, objs, result)
+
+
+def test_engine_invariants_with_cluster_daemonset():
+    rng = random.Random(77)
+    for trial in range(4):
+        nodes = _rand_cluster(rng)
+        objs, _ = _rand_workloads(rng, 2)
+        ds = make_daemonset(
+            "agent", namespace="kube-system", cpu="100m", memory="64Mi",
+            with_tolerations=[{"operator": "Exists"}],
+        )
+        cluster = ClusterResource(nodes=nodes, daemonsets=[ds])
+        result = simulate(cluster, [AppResource(name="inv", objects=objs)])
+        _check_invariants(cluster, objs, result)
