@@ -216,4 +216,10 @@ def build_extenders(
                 "(simon disables DefaultBinder; the engine's preemption pass "
                 "has no extender hook)", e.base,
             )
-    return exts
+    # The reference moves ignorable extenders to the tail of the chain
+    # (factory.go:111-113) so a non-ignorable extender's error aborts the pod
+    # before any ignorable one runs; failedNodes first-wins attribution
+    # follows the same order.
+    return [e for e in exts if not e.is_ignorable] + [
+        e for e in exts if e.is_ignorable
+    ]

@@ -246,7 +246,16 @@ class Simulator:
             )
         else:
             self._profiles = {DEFAULT_SCHEDULER: (self.weights, None)}
-        self.enc = Encoder(topology_keys=("kubernetes.io/hostname",))
+        # Extender-managed ignoredByScheduler resources never enter the fit
+        # tensors (factory.go:105-130 adds them to NodeResourcesFit's
+        # IgnoredResources for every profile).
+        ignored_res = [
+            r for e in self._extenders for r in e.cfg.ignored_resources
+        ]
+        self.enc = Encoder(
+            topology_keys=("kubernetes.io/hostname",),
+            ignored_resources=ignored_res,
+        )
         self._bound: List[Tuple[Pod, str]] = []   # (pod, node name)
         self._pending_cluster: List[Pod] = []
         for pod in cluster.pods:

@@ -149,6 +149,12 @@ class ExtenderConfig:
     node_cache_capable: bool = False
     # resource names; empty = interested in every pod (extender.go:442-445)
     managed_resources: List[str] = field(default_factory=list)
+    # managedResources[].ignoredByScheduler names: the reference adds these
+    # to NodeResourcesFit's IgnoredResources for every profile
+    # (vendor/.../scheduler/factory.go:105-130) so the in-tree resource fit
+    # never rejects a pod for an extender-owned resource — the engine skips
+    # encoding them into the fit tensors (ops/encode.Encoder).
+    ignored_resources: List[str] = field(default_factory=list)
     ignorable: bool = False
 
     @staticmethod
@@ -164,6 +170,16 @@ class ExtenderConfig:
                     f"extender httpTimeout: invalid duration {timeout!r}"
                 )
             seconds = parsed
+        if seconds <= 0:
+            # kube component-config validation requires a positive
+            # HTTPTimeout; letting it through crashes urlopen(timeout<0)
+            # mid-simulation instead of failing at parse time
+            raise ValueError(
+                f"extender httpTimeout: must be positive, got {timeout!r}"
+            )
+        managed = [
+            r for r in (d.get("managedResources") or []) if isinstance(r, dict)
+        ]
         return ExtenderConfig(
             url_prefix=d.get("urlPrefix", "") or "",
             filter_verb=d.get("filterVerb", "") or "",
@@ -174,10 +190,11 @@ class ExtenderConfig:
             enable_https=bool(d.get("enableHTTPS")),
             http_timeout_s=seconds,
             node_cache_capable=bool(d.get("nodeCacheCapable")),
-            managed_resources=[
+            managed_resources=[r.get("name", "") for r in managed],
+            ignored_resources=[
                 r.get("name", "")
-                for r in (d.get("managedResources") or [])
-                if isinstance(r, dict)
+                for r in managed
+                if r.get("ignoredByScheduler") and r.get("name")
             ],
             ignorable=bool(d.get("ignorable")),
         )

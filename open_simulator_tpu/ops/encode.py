@@ -143,7 +143,18 @@ class Encoder:
 
     UNSCHED_TAINT_KEY = "node.kubernetes.io/unschedulable"
 
-    def __init__(self, topology_keys: Sequence[str] = ()) -> None:
+    def __init__(
+        self,
+        topology_keys: Sequence[str] = (),
+        ignored_resources: Sequence[str] = (),
+    ) -> None:
+        # Extender-managed resources with ignoredByScheduler: the reference
+        # adds these to NodeResourcesFit's IgnoredResources for every profile
+        # (vendor/.../scheduler/factory.go:105-130). Skipping them here keeps
+        # them out of the req/alloc tensors entirely, so the device resource
+        # filter never sees them — the extender (which matches interest on
+        # the raw pod.requests dict) remains the sole authority.
+        self.ignored_resources = frozenset(r for r in ignored_resources if r)
         self.keys = Vocab()        # label keys
         self.vals = Vocab()        # label values
         # Pre-intern ids the kernels reference as scalars, so they are stable
@@ -262,7 +273,8 @@ class Encoder:
                 continue
             seen.add(sig)
             for r in pod.requests:
-                self.resource_index(r)
+                if r not in self.ignored_resources:
+                    self.resource_index(r)
             for c in pod.spread_constraints:
                 if c.topology_key:
                     self.topo_index(c.topology_key)
@@ -661,6 +673,8 @@ def encode_pods(
         b.has_req[i] = bool(pod.requests)
         b.owned_by_rs[i] = pod.meta.owner_kind in ("ReplicaSet", "ReplicationController")
         for res, q in pod.requests.items():
+            if res in enc.ignored_resources:
+                continue  # extender-owned (factory.go:105-130), not fit-checked
             b.req[i, enc.resource_index(res)] = q / resource_scale(res)
         b.req[i, enc.resources.index("pods")] += 1.0  # each pod occupies a slot
         b.gpu_mem[i] = np.float32(pod.gpu_mem_request() / float(1 << 20))

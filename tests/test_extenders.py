@@ -398,6 +398,110 @@ def test_fuzz_extender_path_parity(stub_factory):
         assert len(base.unscheduled) == len(ext.unscheduled), f"trial {trial}"
 
 
+def test_ignored_by_scheduler_resource_skips_fit(stub_factory):
+    """managedResources[].ignoredByScheduler: the reference adds the resource
+    to NodeResourcesFit's IgnoredResources (factory.go:105-130), so a pod
+    requesting an extender-owned resource is NOT rejected by the in-tree fit
+    (nodes allocate 0 of it) — placement authority stays with the extender."""
+    stub = stub_factory({"allow": {"n1"}})
+    widget_deploy = {
+        "kind": "Deployment",
+        "metadata": {"name": "w", "namespace": "x"},
+        "spec": {
+            "replicas": 1,
+            "template": {
+                "metadata": {"labels": {"app": "w"}},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "c",
+                            "image": "i",
+                            "resources": {
+                                "requests": {
+                                    "cpu": "1",
+                                    "example.com/widget": "1",
+                                },
+                                "limits": {"example.com/widget": "1"},
+                            },
+                        }
+                    ]
+                },
+            },
+        },
+    }
+    cfg = ExtenderConfig(
+        url_prefix=stub.url,
+        filter_verb="filter",
+        managed_resources=["example.com/widget"],
+        ignored_resources=["example.com/widget"],
+    )
+    res = simulate(
+        ClusterResource(nodes=_nodes(3)),
+        [AppResource(name="x", objects=[widget_deploy])],
+        extenders=[cfg],
+    )
+    assert not res.unscheduled, [u.reason for u in res.unscheduled]
+    assert {st.node.name for st in res.node_status if st.pods} == {"n1"}
+    assert stub.calls  # the extender, not the fit filter, placed the pod
+
+    # contrast: withOUT ignoredByScheduler the fit filter owns the resource,
+    # nodes allocate 0 of it, and the pod is unschedulable everywhere
+    cfg2 = ExtenderConfig(
+        url_prefix=stub.url,
+        filter_verb="filter",
+        managed_resources=["example.com/widget"],
+    )
+    res2 = simulate(
+        ClusterResource(nodes=_nodes(3)),
+        [AppResource(name="x", objects=[widget_deploy])],
+        extenders=[cfg2],
+    )
+    assert len(res2.unscheduled) == 1
+
+
+def test_ignored_by_scheduler_parsed_from_config(tmp_path):
+    cfg_file = tmp_path / "sched.yaml"
+    cfg_file.write_text(
+        """
+kind: KubeSchedulerConfiguration
+extenders:
+  - urlPrefix: http://svc:8000/ext
+    filterVerb: filter
+    managedResources:
+      - name: example.com/gpu
+        ignoredByScheduler: true
+      - name: example.com/fit-checked
+"""
+    )
+    e = load_scheduler_config(str(cfg_file)).extenders[0]
+    assert e.managed_resources == ["example.com/gpu", "example.com/fit-checked"]
+    assert e.ignored_resources == ["example.com/gpu"]
+
+
+def test_ignorable_extenders_moved_to_tail():
+    """factory.go:111-113: ignorable extenders run after all non-ignorable
+    ones regardless of config order."""
+    from open_simulator_tpu.engine.extenders import build_extenders
+
+    cfgs = [
+        ExtenderConfig(url_prefix="http://a", filter_verb="f", ignorable=True),
+        ExtenderConfig(url_prefix="http://b", filter_verb="f"),
+        ExtenderConfig(url_prefix="http://c", filter_verb="f", ignorable=True),
+        ExtenderConfig(url_prefix="http://d", filter_verb="f"),
+    ]
+    order = [e.base for e in build_extenders(cfgs)]
+    assert order == ["http://b", "http://d", "http://a", "http://c"]
+
+
+def test_non_positive_http_timeout_rejected():
+    with pytest.raises(ValueError, match="must be positive"):
+        ExtenderConfig.from_dict({"httpTimeout": "-5s"})
+    with pytest.raises(ValueError, match="must be positive"):
+        ExtenderConfig.from_dict({"httpTimeout": "0s"})
+    with pytest.raises(ValueError, match="must be positive"):
+        ExtenderConfig.from_dict({"httpTimeout": -3})
+
+
 def test_zero_weight_prioritizer_rejected(tmp_path):
     bad = tmp_path / "w0.yaml"
     bad.write_text(
