@@ -51,6 +51,9 @@ def _pod_json(pod: Pod) -> dict:
     meta = dict(d.get("metadata") or {})
     meta["name"] = pod.meta.name
     meta["namespace"] = pod.meta.namespace or "default"
+    # an extender following the k8s protocol names preemption victims by
+    # string(pod.UID); emit the engine's pod identity so it round-trips
+    meta.setdefault("uid", _pod_uid(pod))
     if pod.meta.labels:
         meta["labels"] = dict(pod.meta.labels)
     if pod.meta.annotations:
@@ -73,6 +76,16 @@ def _pod_json(pod: Pod) -> dict:
         ]
     d["spec"] = spec
     return d
+
+
+def _pod_uid(pod: Pod) -> str:
+    """MetaPod identity (extender.go:255-260 uses string(pod.UID)). Simulated
+    pods usually carry no UID, so fall back to namespace/name — unique here
+    because workload expansion uniquifies names with RNG suffixes. _pod_json
+    emits this same value as metadata.uid, so a protocol-conformant extender
+    that echoes string(pod.UID) round-trips."""
+    uid = ((pod.raw or {}).get("metadata") or {}).get("uid")
+    return str(uid) if uid else f"{pod.meta.namespace or 'default'}/{pod.meta.name}"
 
 
 def _node_json(node: Node) -> dict:
@@ -122,8 +135,9 @@ class HTTPExtender:
             method="POST",
         )
         try:
+            # http_timeout_s == 0 means no client timeout (Go zero Timeout)
             with urllib.request.urlopen(
-                req, timeout=self.cfg.http_timeout_s
+                req, timeout=self.cfg.http_timeout_s or None
             ) as resp:
                 body = resp.read()
                 if resp.status != 200:
@@ -186,6 +200,86 @@ class HTTPExtender:
         }
         return out, failed
 
+    # -- extender.go:158-230 ------------------------------------------------
+    @property
+    def supports_preemption(self) -> bool:
+        """SupportsPreemption (extender.go:160-162): preemptVerb defined."""
+        return bool(self.cfg.preempt_verb)
+
+    def process_preemption(
+        self,
+        pod: Pod,
+        victims_map: Dict[str, Tuple[List[Pod], int]],
+        pods_on_node: Dict[str, List[Pod]],
+    ) -> Dict[str, Tuple[List[Pod], int]]:
+        """ProcessPreemption (extender.go:164-205): send the candidate
+        node -> victims map, return the extender's trimmed map. The extender
+        may veto whole nodes (dropping map keys) or trim/replace victims on a
+        node (any pod bound there is addressable, like the reference's
+        nodeInfo.Pods lookup).
+
+        `victims_map`: node name -> (victim pods, numPDBViolations).
+        `pods_on_node`: node name -> all bound pods (the NodeInfoLister
+        analog used to resolve returned MetaPod UIDs back to pods).
+
+        Raises ExtenderError on transport errors or on a response naming an
+        unknown node/pod UID (convertPodUIDToPod treats cache inconsistency
+        as an error, extender.go:236-253)."""
+        if not self.supports_preemption:
+            raise ExtenderError(
+                f"preempt verb is not defined for extender {self.base} but "
+                "run into ProcessPreemption"
+            )
+        args: dict = {"Pod": _pod_json(pod)}
+        if self.cfg.node_cache_capable:
+            # MetaVictims: pod identity only (UIDs). The reference's
+            # convertToNodeNameToMetaVictims builds Pods and leaves
+            # NumPDBViolations at its zero value (extender.go:246-268) —
+            # send 0 for byte parity, not the real count.
+            args["NodeNameToMetaVictims"] = {
+                node: {
+                    "Pods": [{"UID": _pod_uid(v)} for v in victims],
+                    "NumPDBViolations": 0,
+                }
+                for node, (victims, _n_viol) in victims_map.items()
+            }
+        else:
+            args["NodeNameToVictims"] = {
+                node: {
+                    "Pods": [_pod_json(v) for v in victims],
+                    "NumPDBViolations": n_viol,
+                }
+                for node, (victims, n_viol) in victims_map.items()
+            }
+        result = self._send(self.cfg.preempt_verb, args)
+        # The extender always returns NodeNameToMetaVictims (extender.go:195)
+        out: Dict[str, Tuple[List[Pod], int]] = {}
+        for node, meta in (result.get("NodeNameToMetaVictims") or {}).items():
+            bound = pods_on_node.get(node)
+            if bound is None:
+                raise ExtenderError(
+                    f"extender {self.base} returned preemption victims on "
+                    f"unknown node {node!r}"
+                )
+            by_uid = {_pod_uid(p): p for p in bound}
+            victims: List[Pod] = []
+            for mp in (meta or {}).get("Pods") or []:
+                uid = str((mp or {}).get("UID", ""))
+                v = by_uid.get(uid)
+                if v is None:
+                    raise ExtenderError(
+                        f"extender {self.base} returned victim pod {uid!r} "
+                        f"not found on node {node!r} (cache inconsistency)"
+                    )
+                victims.append(v)
+            # Parity quirk: the vendored convertToNodeNameToVictims rebuilds
+            # Victims{Pods} WITHOUT copying NumPDBViolations
+            # (extender.go:211-230), so candidates that pass through an
+            # extender lose their violation count — pickOneNode then
+            # tiebreaks on victim priorities alone. Mirrored exactly.
+            out[node] = (victims, 0)
+        return out
+
     # -- extender.go:343-381 ------------------------------------------------
     def prioritize(
         self, pod: Pod, nodes: Sequence[Node]
@@ -210,11 +304,10 @@ def build_extenders(
 ) -> List[HTTPExtender]:
     exts = [HTTPExtender(c) for c in (configs or [])]
     for e in exts:
-        if e.cfg.preempt_verb or e.cfg.bind_verb:
+        if e.cfg.bind_verb:
             log.warning(
-                "extender %s: preemptVerb/bindVerb are accepted but inert "
-                "(simon disables DefaultBinder; the engine's preemption pass "
-                "has no extender hook)", e.base,
+                "extender %s: bindVerb is accepted but inert (simon disables "
+                "DefaultBinder and binds through its own plugin)", e.base,
             )
     # The reference moves ignorable extenders to the tail of the chain
     # (factory.go:111-113) so a non-ignorable extender's error aborts the pod

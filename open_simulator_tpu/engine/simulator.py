@@ -831,6 +831,7 @@ class Simulator:
         Successful preemptors are rescheduled immediately; victims are
         removed from the cluster (the reference deletes them,
         default_preemption.go PrepareCandidate)."""
+        from .extenders import ExtenderError
         from .preemption import try_preempt
 
         still_failed: List[UnscheduledPod] = []
@@ -846,10 +847,20 @@ class Simulator:
                 for p, node_name in self._bound:
                     bound_by_node.setdefault(node_name, []).append(p)
                 fits_many_fn = self._device_fits_many(bound_by_node)
-            res = try_preempt(
-                pod, self.cluster.nodes, bound_by_node, self._pdbs,
-                fits_many_fn=fits_many_fn,
-            )
+            try:
+                res = try_preempt(
+                    pod, self.cluster.nodes, bound_by_node, self._pdbs,
+                    fits_many_fn=fits_many_fn, extenders=self._extenders,
+                )
+            except ExtenderError as e:
+                # a non-ignorable extender failed ProcessPreemption: the
+                # reference aborts this pod's preemption with the error
+                # (default_preemption.go:373-374) — the pod stays failed
+                # with the extender's message appended
+                still_failed.append(
+                    UnscheduledPod(pod=pod, reason=f"{u.reason}; {e}")
+                )
+                continue
             if res is None or not res.victims:
                 still_failed.append(u)
                 continue

@@ -130,13 +130,26 @@ def _parse_go_duration(s: str) -> Optional[float]:
     return sign * total if total else 0.0
 
 
+def _is_extended_resource_name(name: str) -> bool:
+    """v1helper.IsExtendedResourceName (vendored helpers.go:37-61): a
+    qualified name outside the *kubernetes.io/ namespace — never a native
+    resource (cpu/memory/pods and anything containing "kubernetes.io/"),
+    never a requests.-prefixed quota name."""
+    if "/" not in name or "kubernetes.io/" in name:
+        return False  # IsNativeResource
+    if name.startswith("requests."):
+        return False
+    return True
+
+
 @dataclass
 class ExtenderConfig:
     """One `extenders:` entry of a KubeSchedulerConfiguration (parity:
     vendored KubeSchedulerConfiguration.Extenders → HTTPExtender,
-    vendor/.../scheduler/core/extender.go:93-123). preemptVerb/bindVerb are
-    accepted but inert: simon disables DefaultBinder and binds through its own
-    plugin, and the engine's preemption pass has no extender hook."""
+    vendor/.../scheduler/core/extender.go:93-123). preemptVerb wires into the
+    preemption pass (ProcessPreemption, engine/preemption.py). bindVerb is
+    accepted but inert: simon disables DefaultBinder and binds through its
+    own plugin."""
 
     url_prefix: str = ""
     filter_verb: str = ""
@@ -170,16 +183,29 @@ class ExtenderConfig:
                     f"extender httpTimeout: invalid duration {timeout!r}"
                 )
             seconds = parsed
-        if seconds <= 0:
-            # kube component-config validation requires a positive
-            # HTTPTimeout; letting it through crashes urlopen(timeout<0)
+        if seconds < 0:
+            # a Go http.Client with negative Timeout fails every request;
+            # letting it through would crash urlopen(timeout<0)
             # mid-simulation instead of failing at parse time
             raise ValueError(
-                f"extender httpTimeout: must be positive, got {timeout!r}"
+                f"extender httpTimeout: must not be negative, got {timeout!r}"
             )
+        # httpTimeout: 0 is reference-valid (Go zero Timeout = no client
+        # timeout); http_timeout_s=0.0 means "no timeout" in _send
         managed = [
             r for r in (d.get("managedResources") or []) if isinstance(r, dict)
         ]
+        for r in managed:
+            name = r.get("name", "")
+            if name and not _is_extended_resource_name(name):
+                # kube component-config validation requires managedResources
+                # names to be extended resources (validation.go:149,
+                # validateExtendedResourceName) — a native name like "cpu"
+                # with ignoredByScheduler would disable the in-tree fit check
+                raise ValueError(
+                    f"extender managedResources: {name!r} is not an extended "
+                    "resource name"
+                )
         return ExtenderConfig(
             url_prefix=d.get("urlPrefix", "") or "",
             filter_verb=d.get("filterVerb", "") or "",
@@ -287,10 +313,11 @@ def load_scheduler_config(path: Optional[str]) -> SchedulerConfig:
         ext = ExtenderConfig.from_dict(e or {})
         if not ext.url_prefix:
             raise ValueError(f"{path}: extender missing urlPrefix")
-        if not ext.filter_verb and not ext.prioritize_verb:
+        if not ext.filter_verb and not ext.prioritize_verb and not ext.preempt_verb:
             raise ValueError(
-                f"{path}: extender {ext.url_prefix}: neither filterVerb nor "
-                "prioritizeVerb set — nothing for the engine to call"
+                f"{path}: extender {ext.url_prefix}: neither filterVerb, "
+                "prioritizeVerb nor preemptVerb set — nothing for the "
+                "engine to call"
             )
         if ext.prioritize_verb and ext.weight <= 0:
             # kube's component-config validation: a prioritizing extender

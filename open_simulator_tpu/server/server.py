@@ -40,6 +40,45 @@ _busy = threading.Lock()
 _kubeconfig: Optional[str] = None  # set by serve()/make_server()
 _master: str = ""                  # apiserver URL override (--master)
 
+# Live-cluster snapshot cache (parity: the reference serves every request
+# from a SharedInformerFactory with a 30 s resync period, synced once at
+# startup — server.go:98-136 — rather than re-listing the apiserver per
+# request). The snapshot is re-fetched only when older than _resync_s;
+# requests in between reuse it, so per-request latency against a large real
+# cluster is simulation-bound, not list-bound. Accessed only under _busy.
+RESYNC_SECONDS = 30.0
+_resync_s = RESYNC_SECONDS
+_snapshot: Optional[ClusterResource] = None
+_snapshot_at = 0.0
+_snapshot_fetches = 0  # observability + test hook
+
+
+def _live_snapshot() -> ClusterResource:
+    """Cached kubeconfig/master-backed cluster snapshot. Returns a fresh
+    ClusterResource wrapper over shared immutable objects: request handling
+    appends newNodes / filters pods on the wrapper's lists, and simulate()
+    deep-copies every pod it mutates, so sharing Node/Pod objects across
+    requests is safe."""
+    import time
+
+    global _snapshot, _snapshot_at, _snapshot_fetches
+    now = time.monotonic()
+    if _snapshot is None or now - _snapshot_at > _resync_s:
+        from ..utils.kubeclient import create_cluster_resource_from_kubeconfig
+
+        _snapshot = create_cluster_resource_from_kubeconfig(
+            _kubeconfig or "", master=_master
+        )
+        _snapshot_at = now
+        _snapshot_fetches += 1
+    c = _snapshot
+    return ClusterResource(
+        nodes=list(c.nodes),
+        pods=list(c.pods),
+        daemonsets=list(c.daemonsets),
+        others={k: list(v) for k, v in c.others.items()},
+    )
+
 
 def _simulate_request(body: dict) -> dict:
     cluster_spec = body.get("cluster") or {}
@@ -49,11 +88,7 @@ def _simulate_request(body: dict) -> dict:
     elif cluster_spec.get("objects"):
         cluster = ClusterResource.from_objects(list(cluster_spec["objects"]))
     elif _kubeconfig or _master:
-        from ..utils.kubeclient import create_cluster_resource_from_kubeconfig
-
-        cluster = create_cluster_resource_from_kubeconfig(
-            _kubeconfig or "", master=_master
-        )
+        cluster = _live_snapshot()
     else:
         cluster = ClusterResource.from_objects([])
     for nd in body.get("newNodes") or []:
@@ -127,6 +162,33 @@ def _cpu_profile(seconds: float) -> dict:
     return {"seconds": seconds, "polls": n, "stacks": top}
 
 
+def _goroutine_dump() -> dict:
+    """Instantaneous all-thread stack dump (the `/debug/pprof/goroutine`
+    analog — the exact tool the reference's leak postmortem used,
+    docs/design/内存泄漏.md). One pass over sys._current_frames(), no
+    sampling window: safe to hit on a wedged process."""
+    import sys
+    import traceback
+
+    names = {t.ident: t for t in threading.enumerate()}
+    threads = []
+    for tid, frame in sys._current_frames().items():
+        t = names.get(tid)
+        threads.append(
+            {
+                "id": tid,
+                "name": t.name if t else "?",
+                "daemon": bool(t.daemon) if t else None,
+                "stack": [
+                    f"{fs.filename}:{fs.lineno}:{fs.name}"
+                    for fs in traceback.extract_stack(frame)
+                ],
+            }
+        )
+    threads.sort(key=lambda d: d["id"])
+    return {"count": len(threads), "threads": threads}
+
+
 _tracemalloc_on = False
 
 
@@ -195,6 +257,8 @@ class _Handler(BaseHTTPRequestHandler):
             except ValueError:
                 seconds = 2.0
             self._send(200, _cpu_profile(seconds))
+        elif self.path.startswith("/debug/pprof/goroutine"):
+            self._send(200, _goroutine_dump())
         elif self.path.startswith("/debug/pprof/heap"):
             self._send(200, _heap_profile())
         elif self.path == "/test":

@@ -41,6 +41,10 @@ class _StubExtender:
     - scores: {node: int 0..10} returned by prioritize
     - error: string returned as ExtenderFilterResult.Error
     - http_error: int -> respond with that status code
+    - preempt_allow: set of node names kept in ProcessPreemption (None =
+      keep all); victims echo back unchanged (as MetaVictims UIDs)
+    - preempt_raw: full NodeNameToMetaVictims dict to return verbatim
+      (overrides preempt_allow)
     Records every request body in .calls."""
 
     def __init__(self, behavior):
@@ -88,6 +92,50 @@ class _StubExtender:
                             },
                             "FailedNodes": failed,
                             "Error": stub.behavior.get("error", ""),
+                        }
+                elif self.path.endswith("/preempt"):
+                    if stub.behavior.get("preempt_raw") is not None:
+                        resp = {
+                            "NodeNameToMetaVictims": stub.behavior["preempt_raw"]
+                        }
+                    else:
+                        # echo victims back as MetaVictims, keeping only
+                        # preempt_allow nodes (None = keep all)
+                        allow = stub.behavior.get("preempt_allow")
+                        meta = body.get("NodeNameToMetaVictims")
+                        if meta is None:
+                            meta = {
+                                node: {
+                                    "Pods": [
+                                        {
+                                            "UID": (
+                                                (p.get("metadata") or {}).get("uid")
+                                                or "{}/{}".format(
+                                                    (p.get("metadata") or {}).get(
+                                                        "namespace", "default"
+                                                    ),
+                                                    (p.get("metadata") or {}).get(
+                                                        "name", ""
+                                                    ),
+                                                )
+                                            )
+                                        }
+                                        for p in (v or {}).get("Pods") or []
+                                    ],
+                                    "NumPDBViolations": (v or {}).get(
+                                        "NumPDBViolations", 0
+                                    ),
+                                }
+                                for node, v in (
+                                    body.get("NodeNameToVictims") or {}
+                                ).items()
+                            }
+                        resp = {
+                            "NodeNameToMetaVictims": {
+                                node: v
+                                for node, v in meta.items()
+                                if allow is None or node in allow
+                            }
                         }
                 else:  # prioritize
                     names = body.get("NodeNames")

@@ -374,6 +374,224 @@ def test_server_pprof_endpoints():
         server_mod._tracemalloc_on = False
 
 
+def test_server_goroutine_dump():
+    """/debug/pprof/goroutine: instantaneous all-thread stack dump (the
+    goroutine-dump analog of server.go:152's pprof surface — the tool the
+    reference's leak postmortem leaned on)."""
+    from open_simulator_tpu.server.server import make_server
+
+    srv = make_server(0)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/pprof/goroutine"
+        ) as r:
+            dump = json.load(r)
+        assert dump["count"] >= 2  # at least main + the serving thread
+        assert dump["count"] == len(dump["threads"])
+        all_frames = [
+            frame for th in dump["threads"] for frame in th["stack"]
+        ]
+        # the serving thread's own handler must be visible in its stack
+        assert any("do_GET" in f for f in all_frames)
+        assert all(
+            isinstance(th["name"], str) and th["id"] for th in dump["threads"]
+        )
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_server_snapshot_cache(monkeypatch):
+    """Kubeconfig/master-backed serving reuses one cluster snapshot across
+    requests within the resync TTL (informer-cache parity, server.go:98-136)
+    and refetches after it expires."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from open_simulator_tpu.server import server as server_mod
+
+    list_calls = []
+
+    class _API(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802
+            path = self.path.split("?")[0]
+            list_calls.append(path)
+            if path == "/api/v1/nodes":
+                doc = {
+                    "items": [
+                        {
+                            "metadata": {
+                                "name": f"s{i}",
+                                "labels": {"kubernetes.io/hostname": f"s{i}"},
+                            },
+                            "status": {
+                                "allocatable": {
+                                    "cpu": "8",
+                                    "memory": "16Gi",
+                                    "pods": "110",
+                                }
+                            },
+                        }
+                        for i in range(2)
+                    ]
+                }
+            else:
+                doc = {"items": []}
+            data = json.dumps(doc).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, fmt, *args):
+            pass
+
+    api = ThreadingHTTPServer(("127.0.0.1", 0), _API)
+    threading.Thread(target=api.serve_forever, daemon=True).start()
+    monkeypatch.setattr(
+        server_mod, "_master", f"http://127.0.0.1:{api.server_address[1]}"
+    )
+    monkeypatch.setattr(server_mod, "_kubeconfig", None)
+    monkeypatch.setattr(server_mod, "_snapshot", None)
+    monkeypatch.setattr(server_mod, "_snapshot_at", 0.0)
+    monkeypatch.setattr(server_mod, "_snapshot_fetches", 0)
+    monkeypatch.setattr(server_mod, "_resync_s", 3600.0)
+    try:
+        app = {
+            "name": "a",
+            "objects": [
+                {
+                    "kind": "Deployment",
+                    "metadata": {"name": "d", "namespace": "x"},
+                    "spec": {
+                        "replicas": 1,
+                        "template": {
+                            "metadata": {"labels": {"app": "d"}},
+                            "spec": {
+                                "containers": [
+                                    {
+                                        "name": "c",
+                                        "image": "i",
+                                        "resources": {
+                                            "requests": {"cpu": "1"}
+                                        },
+                                    }
+                                ]
+                            },
+                        },
+                    },
+                }
+            ],
+        }
+        out1 = server_mod._simulate_request({"apps": [app]})
+        assert len(out1["placements"]) == 1
+        n_lists_after_first = len(list_calls)
+        out2 = server_mod._simulate_request({"apps": [app]})
+        assert len(out2["placements"]) == 1
+        # second request served from the cached snapshot: no new list calls
+        assert len(list_calls) == n_lists_after_first
+        assert server_mod._snapshot_fetches == 1
+        # expire the TTL -> the next request refetches (30 s resync parity)
+        monkeypatch.setattr(server_mod, "_snapshot_at", -10_000.0)
+        server_mod._simulate_request({"apps": [app]})
+        assert server_mod._snapshot_fetches == 2
+        assert len(list_calls) > n_lists_after_first
+        # the cached snapshot itself must stay pristine: a request that
+        # appends newNodes / filters pods works on a fresh wrapper
+        before = len(server_mod._snapshot.nodes)
+        server_mod._simulate_request(
+            {
+                "apps": [app],
+                "newNodes": [
+                    {
+                        "kind": "Node",
+                        "metadata": {
+                            "name": "extra",
+                            "labels": {"kubernetes.io/hostname": "extra"},
+                        },
+                        "status": {
+                            "allocatable": {
+                                "cpu": "8",
+                                "memory": "16Gi",
+                                "pods": "110",
+                            }
+                        },
+                    }
+                ],
+            }
+        )
+        assert len(server_mod._snapshot.nodes) == before
+    finally:
+        api.shutdown()
+        api.server_close()
+
+
+def test_server_rss_soak(cfg):
+    """100 sequential deploy-apps requests must not grow RSS unboundedly —
+    the rebuild's regression guard for the reference's production memory
+    leak (docs/design/内存泄漏.md: goroutine/informer leak grew RSS per
+    request until OOM). Warm up 10 requests (jit caches fill), then assert
+    the remaining 90 add < 120 MB."""
+    from open_simulator_tpu.server.server import make_server
+
+    def rss_mb() -> float:
+        with open("/proc/self/status") as fh:
+            for line in fh:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / 1024.0
+        return 0.0
+
+    srv = make_server(0)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+
+    import yaml
+
+    from open_simulator_tpu.utils.yamlio import walk_files
+
+    cluster_objs = []
+    for f in walk_files(os.path.join(FIXTURES, "cluster"), (".yaml", ".yml")):
+        cluster_objs.extend(d for d in yaml.safe_load_all(open(f)) if d)
+    app_objs = []
+    for f in walk_files(os.path.join(FIXTURES, "app"), (".yaml", ".yml")):
+        app_objs.extend(d for d in yaml.safe_load_all(open(f)) if d)
+    body = json.dumps(
+        {
+            "cluster": {"objects": cluster_objs},
+            "apps": [{"name": "soak", "objects": app_objs}],
+        }
+    ).encode()
+
+    curve = []
+    try:
+        for i in range(100):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/deploy-apps",
+                data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req) as r:
+                payload = json.load(r)
+            assert payload["unscheduled"] == []
+            if i in (0, 9, 24, 49, 74, 99):
+                curve.append((i + 1, round(rss_mb(), 1)))
+        warm = dict(curve)[10]
+        final = dict(curve)[100]
+        growth = final - warm
+        # bounded: steady-state requests must not accumulate memory. The
+        # bound is generous (fragmentation, allocator slack) — a real leak
+        # like the reference's grows without bound and blows through it.
+        assert growth < 120.0, f"RSS grew {growth:.1f} MB over 90 warm requests: {curve}"
+        print(f"RSS soak curve (requests, MB): {curve}")
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
 def test_report_colorization(cfg, monkeypatch):
     from open_simulator_tpu.utils.tables import colorize_report
 

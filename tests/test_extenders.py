@@ -228,7 +228,9 @@ extenders:
     bad.write_text(
         "kind: KubeSchedulerConfiguration\nextenders:\n  - urlPrefix: http://x\n    bindVerb: bind\n"
     )
-    with pytest.raises(ValueError, match="neither filterVerb nor prioritizeVerb"):
+    with pytest.raises(
+        ValueError, match="neither filterVerb, prioritizeVerb nor preemptVerb"
+    ):
         load_scheduler_config(str(bad))
 
 
@@ -493,13 +495,14 @@ def test_ignorable_extenders_moved_to_tail():
     assert order == ["http://b", "http://d", "http://a", "http://c"]
 
 
-def test_non_positive_http_timeout_rejected():
-    with pytest.raises(ValueError, match="must be positive"):
+def test_negative_http_timeout_rejected():
+    with pytest.raises(ValueError, match="must not be negative"):
         ExtenderConfig.from_dict({"httpTimeout": "-5s"})
-    with pytest.raises(ValueError, match="must be positive"):
-        ExtenderConfig.from_dict({"httpTimeout": "0s"})
-    with pytest.raises(ValueError, match="must be positive"):
+    with pytest.raises(ValueError, match="must not be negative"):
         ExtenderConfig.from_dict({"httpTimeout": -3})
+    # 0 is reference-valid: Go's zero http.Client Timeout = no timeout
+    assert ExtenderConfig.from_dict({"httpTimeout": "0s"}).http_timeout_s == 0.0
+    assert ExtenderConfig.from_dict({"httpTimeout": 0}).http_timeout_s == 0.0
 
 
 def test_zero_weight_prioritizer_rejected(tmp_path):
@@ -510,6 +513,227 @@ def test_zero_weight_prioritizer_rejected(tmp_path):
     )
     with pytest.raises(ValueError, match="non-positive weight"):
         load_scheduler_config(str(bad))
+
+
+def _preempt_cluster():
+    """Two 4-cpu nodes, each pre-filled by a bound low-priority 3-cpu pod."""
+    from open_simulator_tpu.core.objects import Pod
+
+    nodes = _nodes(2, cpu="4")
+    bound = [
+        Pod.from_dict(
+            {
+                "metadata": {
+                    "name": f"low-{i}",
+                    "namespace": "p",
+                    "labels": {"app": "low"},
+                },
+                "spec": {
+                    "nodeName": f"n{i}",
+                    "priority": 0,
+                    "containers": [
+                        {
+                            "name": "c",
+                            "image": "i",
+                            "resources": {"requests": {"cpu": "3"}},
+                        }
+                    ],
+                },
+            }
+        )
+        for i in range(2)
+    ]
+    return ClusterResource(nodes=nodes, pods=bound)
+
+
+def _high_deploy():
+    return {
+        "kind": "Deployment",
+        "metadata": {"name": "high", "namespace": "p"},
+        "spec": {
+            "replicas": 1,
+            "template": {
+                "metadata": {"labels": {"app": "high"}},
+                "spec": {
+                    "priority": 100,
+                    "containers": [
+                        {"name": "c", "image": "i",
+                         "resources": {"requests": {"cpu": "3"}}}
+                    ],
+                },
+            },
+        },
+    }
+
+
+def _preempt_ext(url, **kw):
+    return ExtenderConfig(url_prefix=url, preempt_verb="preempt", **kw)
+
+
+def test_process_preemption_vetoes_host_pick(stub_factory):
+    """CallExtenders parity (default_preemption.go:346-394): both nodes are
+    preemption candidates and the host tiebreak would pick n0 (first lane);
+    the extender keeps only n1, so the engine must evict there instead."""
+    # baseline: without the extender the host pick lands on n0
+    base = simulate(
+        _preempt_cluster(), [AppResource(name="p", objects=[_high_deploy()])]
+    )
+    assert not base.unscheduled
+    assert {p.node for p in base.preempted} == {"n0"}
+
+    stub = stub_factory({"preempt_allow": {"n1"}})
+    res = simulate(
+        _preempt_cluster(),
+        [AppResource(name="p", objects=[_high_deploy()])],
+        extenders=[_preempt_ext(stub.url)],
+    )
+    assert not res.unscheduled, [u.reason for u in res.unscheduled]
+    assert {p.node for p in res.preempted} == {"n1"}
+    assert [p.pod.meta.name for p in res.preempted] == ["low-1"]
+    # the extender saw the full candidate map with both nodes' victims
+    path, body = stub.calls[0]
+    assert path.endswith("/preempt")
+    sent = body["NodeNameToVictims"]
+    assert set(sent) == {"n0", "n1"}
+    assert [p["metadata"]["name"] for p in sent["n0"]["Pods"]] == ["low-0"]
+
+
+def test_process_preemption_meta_victims_wire(stub_factory):
+    """nodeCacheCapable extenders exchange MetaVictims (UIDs only),
+    extender.go:179-186."""
+    stub = stub_factory({"preempt_allow": {"n1"}})
+    res = simulate(
+        _preempt_cluster(),
+        [AppResource(name="p", objects=[_high_deploy()])],
+        extenders=[_preempt_ext(stub.url, node_cache_capable=True)],
+    )
+    assert not res.unscheduled
+    assert {p.node for p in res.preempted} == {"n1"}
+    path, body = stub.calls[0]
+    assert body.get("NodeNameToVictims") is None
+    meta = body["NodeNameToMetaVictims"]
+    assert set(meta) == {"n0", "n1"}
+    # simulated pods carry no UID -> namespace/name identity
+    assert meta["n1"]["Pods"] == [{"UID": "p/low-1"}]
+
+
+def test_process_preemption_empty_map_fails_pod(stub_factory):
+    """An extender returning an empty map means no preemption anywhere
+    (default_preemption.go:379-382)."""
+    stub = stub_factory({"preempt_allow": set()})
+    res = simulate(
+        _preempt_cluster(),
+        [AppResource(name="p", objects=[_high_deploy()])],
+        extenders=[_preempt_ext(stub.url)],
+    )
+    assert len(res.unscheduled) == 1
+    assert not res.preempted
+
+
+def test_process_preemption_error_policy(stub_factory):
+    """A non-ignorable extender error aborts the pod's preemption with the
+    message; an ignorable one is skipped (default_preemption.go:367-374)."""
+    stub = stub_factory({"http_error": 500})
+    res = simulate(
+        _preempt_cluster(),
+        [AppResource(name="p", objects=[_high_deploy()])],
+        extenders=[_preempt_ext(stub.url)],
+    )
+    assert len(res.unscheduled) == 1
+    assert "extender" in res.unscheduled[0].reason
+    assert not res.preempted
+
+    stub2 = stub_factory({"http_error": 500})
+    res2 = simulate(
+        _preempt_cluster(),
+        [AppResource(name="p", objects=[_high_deploy()])],
+        extenders=[_preempt_ext(stub2.url, ignorable=True)],
+    )
+    assert not res2.unscheduled
+    assert res2.preempted  # preemption proceeded without the extender
+
+
+def test_process_preemption_interest_gating(stub_factory):
+    """Extenders not interested in the pod (managedResources mismatch) and
+    extenders without preemptVerb are never consulted during preemption
+    (default_preemption.go:363-365)."""
+    stub = stub_factory({"preempt_allow": set()})   # would veto everything
+    cfg = _preempt_ext(stub.url, managed_resources=["example.com/widget"])
+    res = simulate(
+        _preempt_cluster(),
+        [AppResource(name="p", objects=[_high_deploy()])],
+        extenders=[cfg],
+    )
+    assert not res.unscheduled
+    assert res.preempted
+    assert stub.calls == []   # never consulted
+
+
+def test_process_preemption_unknown_victim_rejected(stub_factory):
+    """A response naming a pod not bound on the node is a cache
+    inconsistency -> error (extender.go:236-253)."""
+    stub = stub_factory(
+        {"preempt_raw": {"n1": {"Pods": [{"UID": "p/ghost"}],
+                                "NumPDBViolations": 0}}}
+    )
+    res = simulate(
+        _preempt_cluster(),
+        [AppResource(name="p", objects=[_high_deploy()])],
+        extenders=[_preempt_ext(stub.url)],
+    )
+    assert len(res.unscheduled) == 1
+    assert "not found on node" in res.unscheduled[0].reason
+
+
+def test_native_resource_in_managed_resources_rejected():
+    """validateExtendedResourceName parity (validation.go:149): native names
+    cannot be extender-managed — ignoredByScheduler on 'cpu' would disable
+    the in-tree fit check entirely."""
+    for bad in ("cpu", "memory", "pods", "kubernetes.io/batteries",
+                "requests.example.com/widget"):
+        with pytest.raises(ValueError, match="not an extended resource"):
+            ExtenderConfig.from_dict(
+                {"managedResources": [{"name": bad, "ignoredByScheduler": True}]}
+            )
+    ok = ExtenderConfig.from_dict(
+        {"managedResources": [{"name": "example.com/widget"}]}
+    )
+    assert ok.managed_resources == ["example.com/widget"]
+
+
+def test_process_preemption_podfree_node_resolvable(stub_factory):
+    """An extender answering with a cluster node that has no bound pods must
+    resolve through the NodeInfoLister analog (extender.go:214-217), not be
+    misreported as an unknown-node cache inconsistency."""
+    from open_simulator_tpu.core.objects import Pod
+
+    # three nodes; n2 exists but holds no bound pods
+    cluster = _preempt_cluster()
+    cluster.nodes.extend(_nodes(3, cpu="1")[2:])  # adds n2, too small to fit
+    stub = stub_factory(
+        {"preempt_raw": {"n2": {"Pods": [], "NumPDBViolations": 0}}}
+    )
+    res = simulate(
+        cluster,
+        [AppResource(name="p", objects=[_high_deploy()])],
+        extenders=[_preempt_ext(stub.url)],
+    )
+    # victimless candidate on a real node: preemption simply yields nothing
+    # (no ExtenderError) and the pod stays unscheduled with its real reason
+    assert len(res.unscheduled) == 1
+    assert "not found on node" not in res.unscheduled[0].reason
+    assert "unknown node" not in res.unscheduled[0].reason
+    assert not res.preempted
+
+
+def test_preempt_only_extender_config_accepted(tmp_path):
+    cfg_file = tmp_path / "p.yaml"
+    cfg_file.write_text(
+        "kind: KubeSchedulerConfiguration\nextenders:\n"
+        "  - urlPrefix: http://e\n    preemptVerb: preempt\n"
+    )
+    cfg = load_scheduler_config(str(cfg_file))
+    assert cfg.extenders[0].preempt_verb == "preempt"
 
 
 def test_preemption_retry_honors_extender_filter(stub_factory):
