@@ -246,27 +246,33 @@ def _mk_deploy(name, replicas, cpu, mem, labels=None, spec_extra=None, anno=None
 
 
 def config_stock():
-    """Config 1: the reference's stock demo_1 sample (cluster + 5 apps + the
-    add-node capacity search), through the full Applier."""
+    """Config 1: the stock quickstart sample (cluster + 5 apps incl. a chart
+    + the add-node capacity search), through the full Applier. Uses the
+    first-party example/ tree; falls back to the reference's demo_1 only
+    when example/ is missing from the checkout."""
     import io
 
     from open_simulator_tpu.api.config import AppInConfig, SimonConfig
     from open_simulator_tpu.engine.apply import run_apply
 
-    ref = "/root/reference/example"
-    cfg = SimonConfig(
-        custom_config=f"{ref}/cluster/demo_1",
-        new_node=f"{ref}/newnode/demo_1",
-        app_list=[
-            AppInConfig(
-                name="yoda", path=f"{ref}/application/charts/yoda", chart=True
-            ),
-            AppInConfig(name="simple", path=f"{ref}/application/simple"),
-            AppInConfig(name="complicated", path=f"{ref}/application/complicate"),
-            AppInConfig(name="open_local", path=f"{ref}/application/open_local"),
-            AppInConfig(name="more_pods", path=f"{ref}/application/more_pods"),
-        ],
-    )
+    here = os.path.join(os.path.dirname(os.path.abspath(__file__)), "example")
+    if os.path.isdir(os.path.join(here, "cluster", "demo")):
+        cfg = SimonConfig.load(os.path.join(here, "simon-config.yaml"))
+    else:
+        ref = "/root/reference/example"
+        cfg = SimonConfig(
+            custom_config=f"{ref}/cluster/demo_1",
+            new_node=f"{ref}/newnode/demo_1",
+            app_list=[
+                AppInConfig(
+                    name="yoda", path=f"{ref}/application/charts/yoda", chart=True
+                ),
+                AppInConfig(name="simple", path=f"{ref}/application/simple"),
+                AppInConfig(name="complicated", path=f"{ref}/application/complicate"),
+                AppInConfig(name="open_local", path=f"{ref}/application/open_local"),
+                AppInConfig(name="more_pods", path=f"{ref}/application/more_pods"),
+            ],
+        )
     t0 = time.time()
     outcome = run_apply(cfg, out=io.StringIO())
     wall = time.time() - t0
