@@ -462,26 +462,109 @@ def config_preempt(n_nodes=60, n_low=400, n_high=100):
         simulate,
     )
 
-    nodes = [_mk_node(f"n-{i}", "8", "32Gi") for i in range(n_nodes)]
-    low = _mk_deploy("low-tier", n_low, "1", "1Gi")
-    high = _mk_deploy(
-        "high-tier", n_high, "2", "1Gi", spec_extra={"priority": 100}
-    )
-    t0 = time.time()
-    result = simulate(
-        ClusterResource(nodes=nodes),
-        [AppResource(name="bench", objects=[low, high])],
-    )
-    wall = time.time() - t0
-    placed = sum(len(st.pods) for st in result.node_status)
+    def one_run():
+        nodes = [_mk_node(f"n-{i}", "8", "32Gi") for i in range(n_nodes)]
+        low = _mk_deploy("low-tier", n_low, "1", "1Gi")
+        high = _mk_deploy(
+            "high-tier", n_high, "2", "1Gi", spec_extra={"priority": 100}
+        )
+        t0 = time.time()
+        result = simulate(
+            ClusterResource(nodes=nodes),
+            [AppResource(name="bench", objects=[low, high])],
+        )
+        return time.time() - t0, result
+
     n_pods = n_low + n_high
+    # Cold: compiles dominate (every probe lane-bucket shape traces its own
+    # vmapped run_filters). Warm: a second identical run in the same process
+    # reuses every executable — the steady state a server-mode or capacity-
+    # search caller sees, and what the persistent XLA cache gives a fresh
+    # process. The reference pays neither (plain Go calls) but its per-probe
+    # cost is a full filter dry run per candidate node
+    # (default_preemption.go:578-626).
+    cold_wall, cold_res = one_run()
+    warm_wall, result = one_run()
+    placed = sum(len(st.pods) for st in result.node_status)
+    assert len(result.preempted) == len(cold_res.preempted)
     return {
-        "wall_s": round(wall, 2),
-        "value": round(n_pods / wall, 1),
+        "wall_s": round(warm_wall, 2),
+        "value": round(n_pods / warm_wall, 1),
+        "cold_wall_s": round(cold_wall, 2),
+        "cold_value": round(n_pods / cold_wall, 1),
         "scheduled": placed,
         "unscheduled": len(result.unscheduled),
         "preempted": len(result.preempted),
     }
+
+
+def config_extender(n_pods=1_000, n_nodes=100):
+    """Config 7: the extender tax. A local pass-through HTTP extender
+    (filter + prioritize, interested in every pod) forces all 1k pods down
+    the per-pod probe→extend→commit path — per-pod HTTP round trips plus
+    per-pod device dispatch, the cost the reference pays in
+    findNodesThatPassExtenders/prioritizeNodes per scheduling cycle
+    (core/extender.go:273-381). The uninterested batch path's throughput is
+    guarded by the other configs (no extender => identical code path)."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from open_simulator_tpu.engine.simulator import (
+        AppResource,
+        ClusterResource,
+        simulate,
+    )
+    from open_simulator_tpu.models.profiles import ExtenderConfig
+
+    class _PassThrough(BaseHTTPRequestHandler):
+        def do_POST(self):  # noqa: N802
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            if self.path.endswith("/filter"):
+                names = body.get("NodeNames") or []
+                resp = {"NodeNames": names, "FailedNodes": {}, "Error": ""}
+            else:
+                resp = [
+                    {"Host": n, "Score": 5} for n in body.get("NodeNames") or []
+                ]
+            data = json.dumps(resp).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, fmt, *args):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _PassThrough)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        cfg = ExtenderConfig(
+            url_prefix=f"http://127.0.0.1:{httpd.server_address[1]}",
+            filter_verb="filter",
+            prioritize_verb="prioritize",
+            node_cache_capable=True,   # NodeNames wire: isolate dispatch cost
+        )
+        nodes = [_mk_node(f"n-{i}", "16", "64Gi") for i in range(n_nodes)]
+        deploy = _mk_deploy("ext-load", n_pods, "500m", "256Mi")
+        t0 = time.time()
+        result = simulate(
+            ClusterResource(nodes=nodes),
+            [AppResource(name="bench", objects=[deploy])],
+            extenders=[cfg],
+        )
+        wall = time.time() - t0
+        placed = sum(len(st.pods) for st in result.node_status)
+        return {
+            "wall_s": round(wall, 2),
+            "value": round(n_pods / wall, 1),
+            "scheduled": placed,
+            "unscheduled": len(result.unscheduled),
+        }
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
 
 
 CONFIGS = {
@@ -491,6 +574,7 @@ CONFIGS = {
     "gpushare_5k": config_gpushare,
     "plan_100k_10k": config_plan,
     "preempt_tiered": config_preempt,
+    "extender_1k": config_extender,
 }
 
 
@@ -550,6 +634,8 @@ SEGMENT_TIMEOUT_S = {
     "spread_aff_10k_1k": 900.0,
     "gpushare_5k": 900.0,
     "plan_100k_10k": 1200.0,
+    "preempt_tiered": 900.0,
+    "extender_1k": 900.0,
 }
 
 

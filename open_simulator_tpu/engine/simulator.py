@@ -153,6 +153,16 @@ def _reason_string(n_nodes: int, counts: np.ndarray) -> str:
     return f"0/{n_nodes} nodes are available: {detail}."
 
 
+# jitted preemption-probe programs keyed by (out-of-tree filter tuple,
+# packed-layout offsets) — shared across ALL Simulator instances so repeated
+# simulate() calls reuse compiled executables instead of retracing per
+# instance (see _device_fits_many). Bounded FIFO: a long-lived server sees
+# varying table layouts (and identity-keyed plugin closures) per request,
+# and an unbounded cache would pin every stale jit + its executables forever.
+_PROBE_JIT_CACHE: Dict[tuple, object] = {}
+_PROBE_JIT_CACHE_MAX = 32
+
+
 class Simulator:
     """Owns the device-resident cluster state for one simulation run."""
 
@@ -634,72 +644,107 @@ class Simulator:
     # a handful of bucketed shapes
     _PROBE_CHUNK = 256
 
-    def _pod_eviction_delta(self, v: Pod) -> Dict[str, np.ndarray]:
-        """Additive per-column delta of hypothetically evicting pod `v`
-        (reverse of its bind contributions). Shapes match the carry columns;
-        computed once per pod per preemption pass (the encoder lookups —
+    def _pod_eviction_delta(self, v: Pod) -> np.ndarray:
+        """Additive packed-column delta of hypothetically evicting pod `v`
+        (reverse of its bind contributions; layout per _probe_offsets).
+        Computed once per pod per preemption pass (the encoder lookups —
         match_vector/port_ids/anti_ids — are the expensive part)."""
         from ..ops.encode import match_vector, resource_scale
 
-        d = {
-            "free": np.zeros(self._carry.free.shape[1], np.float32),
-            "sel": np.zeros(self._carry.sel_counts.shape[0], np.float32),
-            "gpu": np.zeros(self._carry.gpu_free.shape[1], np.float32),
-            "vg": np.zeros(self._carry.vg_free.shape[1], np.float32),
-            "dev": np.zeros(self._carry.dev_free.shape[1], np.float32),
-            "port_any": np.zeros(self._carry.port_any.shape[0], np.float32),
-            "port_wild": np.zeros(self._carry.port_wild.shape[0], np.float32),
-            "port_ipc": np.zeros(self._carry.port_ipc.shape[0], np.float32),
-            "anti": np.zeros(self._carry.anti_counts.shape[0], np.float32),
-        }
+        offs = self._probe_offsets()
+        d = np.zeros(offs["__total__"][1], np.float32)
+
+        def plane(key):
+            s, e = offs[key]
+            return d[s:e]
+
+        free, sel = plane("free"), plane("sel")
         for res, q in v.requests.items():
             if res in self.enc.resources:
-                r = self.enc.resources.index(res)
-                d["free"][r] += q / resource_scale(res)
-        d["free"][self.enc.resources.index("pods")] += 1.0
+                free[self.enc.resources.index(res)] += q / resource_scale(res)
+        free[self.enc.resources.index("pods")] += 1.0
         vec = match_vector(self.enc, v)
-        m = min(vec.shape[0], d["sel"].shape[0])
-        d["sel"][:m] -= vec[:m]  # evicted pod no longer counts
+        m = min(vec.shape[0], sel.shape[0])
+        sel[:m] -= vec[:m]  # evicted pod no longer counts
         mem = v.gpu_mem_request()
         if mem > 0:
+            gpu = plane("gpu")
             for g in v.gpu_index_ids():
-                if 0 <= g < d["gpu"].shape[0]:
-                    d["gpu"][g] += np.float32(mem / float(1 << 20))
+                if 0 <= g < gpu.shape[0]:
+                    gpu[g] += np.float32(mem / float(1 << 20))
         takes = self._storage_takes.get(v.key)
         if takes is not None:
-            d["vg"][: takes[0].shape[0]] += takes[0]
-            d["dev"][: takes[1].shape[0]] += takes[1]
+            plane("vg")[: takes[0].shape[0]] += takes[0]
+            plane("dev")[: takes[1].shape[0]] += takes[1]
+        port_any, port_wild, port_ipc = (
+            plane("port_any"), plane("port_wild"), plane("port_ipc")
+        )
         for pid, wild, ipid in self.enc.port_ids(v):
-            if pid < d["port_any"].shape[0]:
-                d["port_any"][pid] -= 1.0
+            if pid < port_any.shape[0]:
+                port_any[pid] -= 1.0
                 if wild:
-                    d["port_wild"][pid] -= 1.0
-            if not wild and ipid < d["port_ipc"].shape[0]:
-                d["port_ipc"][ipid] -= 1.0
+                    port_wild[pid] -= 1.0
+            if not wild and ipid < port_ipc.shape[0]:
+                port_ipc[ipid] -= 1.0
+        anti = plane("anti")
         for aid in self.enc.anti_ids(v):
-            if aid < d["anti"].shape[0]:
-                d["anti"][aid] -= 1.0
+            if aid < anti.shape[0]:
+                anti[aid] -= 1.0
         return d
+
+    # Packed probe-column layout: the nine carry planes a hypothetical
+    # eviction touches, flattened into ONE f32 vector per node column. One
+    # numpy slice builds a lane, one vector add applies a victim delta, one
+    # device_put ships a whole chunk — versus nine of each before
+    # (the 80k-dispatch hot spot that held preempt_tiered at ~12 pods/s).
+    _PROBE_PLANES = (
+        ("free", "free", True),        # (packed key, carry field, node-major)
+        ("sel", "sel_counts", False),
+        ("gpu", "gpu_free", True),
+        ("vg", "vg_free", True),
+        ("dev", "dev_free", True),
+        ("port_any", "port_any", False),
+        ("port_wild", "port_wild", False),
+        ("port_ipc", "port_ipc", False),
+        ("anti", "anti_counts", False),
+    )
+
+    def _probe_offsets(self) -> Dict[str, Tuple[int, int]]:
+        """(start, end) of each plane inside the packed probe vector, from
+        the live carry's shapes (static at trace time)."""
+        offs: Dict[str, Tuple[int, int]] = {}
+        pos = 0
+        for key, field_name, node_major in self._PROBE_PLANES:
+            arr = getattr(self._carry, field_name)
+            n = arr.shape[1] if node_major else arr.shape[0]
+            offs[key] = (pos, pos + n)
+            pos += n
+        offs["__total__"] = (0, pos)
+        return offs
+
+    def _carry_host_packed(self) -> np.ndarray:
+        """f32[T, N] — every node's packed probe column, cached by carry
+        identity (any carry swap — bind, evict, reshard — builds a new
+        pytree and invalidates it). Host-side so lane construction is a
+        numpy slice, not an un-jitted device get."""
+        cached = getattr(self, "_carry_np", None)
+        if cached is None or cached[0] is not self._carry:
+            planes = []
+            for key, field_name, node_major in self._PROBE_PLANES:
+                a = np.asarray(getattr(self._carry, field_name), np.float32)
+                planes.append(a.T if node_major else a)
+            self._carry_np = (self._carry, np.concatenate(planes, axis=0))
+        return self._carry_np[1]
 
     def _eviction_cols(
         self, ni: int, on_node, keep_ids, delta_cache: Optional[dict] = None
-    ) -> Dict[str, np.ndarray]:
-        """Node column state with ONLY the kept pods: the current carry column
-        plus the cached eviction delta of every pod not kept. With the shared
-        `delta_cache`, repeated reprieve rounds cost vector adds instead of
-        re-encoding every still-evicted pod (linear, not quadratic, in queue
-        length)."""
-        cols = {
-            "free": np.asarray(self._carry.free[ni]).copy(),
-            "sel": np.asarray(self._carry.sel_counts[:, ni]).copy(),
-            "gpu": np.asarray(self._carry.gpu_free[ni]).copy(),
-            "vg": np.asarray(self._carry.vg_free[ni]).copy(),
-            "dev": np.asarray(self._carry.dev_free[ni]).copy(),
-            "port_any": np.asarray(self._carry.port_any[:, ni]).copy(),
-            "port_wild": np.asarray(self._carry.port_wild[:, ni]).copy(),
-            "port_ipc": np.asarray(self._carry.port_ipc[:, ni]).copy(),
-            "anti": np.asarray(self._carry.anti_counts[:, ni]).copy(),
-        }
+    ) -> np.ndarray:
+        """Packed node column state with ONLY the kept pods: the current
+        carry column plus the cached eviction delta of every pod not kept.
+        With the shared `delta_cache`, repeated reprieve rounds cost one
+        vector add per evicted pod instead of re-encoding it (linear, not
+        quadratic, in queue length)."""
+        cols = self._carry_host_packed()[:, ni].copy()
         for v in on_node:
             if id(v) in keep_ids:
                 continue
@@ -709,8 +754,7 @@ class Simulator:
                     d = delta_cache[id(v)] = self._pod_eviction_delta(v)
             else:
                 d = self._pod_eviction_delta(v)
-            for k in cols:
-                cols[k] += d[k]
+            cols += d
         return cols
 
     def _device_fits_many(self, bound_by_node):
@@ -729,22 +773,40 @@ class Simulator:
         from ..ops.kernels import run_filters
         from ..ops.state import pod_rows_from_batch
 
-        if not hasattr(self, "_probe_fit_many_jit"):
+        # One jitted probe per (out-of-tree filter set, packed layout),
+        # cached at module level: a per-Simulator closure would retrace +
+        # recompile the whole vmapped filter family on EVERY simulate() call
+        # (each capacity probe, each server request, each bench repeat) — the
+        # compile tax that made preempt_tiered run at 11 pods/s warm. Lanes
+        # arrive as packed f32[lanes, T] vectors (see _PROBE_PLANES) and are
+        # unpacked with static offsets inside the jit.
+        offs = self._probe_offsets()
+        key = (
+            self._extra_filters,
+            tuple(sorted(offs.items())),
+        )
+        probe = _PROBE_JIT_CACHE.get(key)
+        if probe is None:
             extra_filters = self._extra_filters
+            o = dict(offs)
+
+            def pl(col, k):
+                s, e = o[k]
+                return col[s:e]
 
             @jax.jit
             def probe_many(ns, carry, row, nis, cols, filter_on):
                 def one(ni, col):
                     carry2 = carry._replace(
-                        free=carry.free.at[ni].set(col["free"]),
-                        sel_counts=carry.sel_counts.at[:, ni].set(col["sel"]),
-                        gpu_free=carry.gpu_free.at[ni].set(col["gpu"]),
-                        vg_free=carry.vg_free.at[ni].set(col["vg"]),
-                        dev_free=carry.dev_free.at[ni].set(col["dev"]),
-                        port_any=carry.port_any.at[:, ni].set(col["port_any"]),
-                        port_wild=carry.port_wild.at[:, ni].set(col["port_wild"]),
-                        port_ipc=carry.port_ipc.at[:, ni].set(col["port_ipc"]),
-                        anti_counts=carry.anti_counts.at[:, ni].set(col["anti"]),
+                        free=carry.free.at[ni].set(pl(col, "free")),
+                        sel_counts=carry.sel_counts.at[:, ni].set(pl(col, "sel")),
+                        gpu_free=carry.gpu_free.at[ni].set(pl(col, "gpu")),
+                        vg_free=carry.vg_free.at[ni].set(pl(col, "vg")),
+                        dev_free=carry.dev_free.at[ni].set(pl(col, "dev")),
+                        port_any=carry.port_any.at[:, ni].set(pl(col, "port_any")),
+                        port_wild=carry.port_wild.at[:, ni].set(pl(col, "port_wild")),
+                        port_ipc=carry.port_ipc.at[:, ni].set(pl(col, "port_ipc")),
+                        anti_counts=carry.anti_counts.at[:, ni].set(pl(col, "anti")),
                     )
                     # same filter set the pod's profile schedules with (mask
                     # + out-of-tree plugins) — a disabled filter must not
@@ -756,7 +818,9 @@ class Simulator:
 
                 return jax.vmap(one)(nis, cols)
 
-            self._probe_fit_many_jit = probe_many
+            while len(_PROBE_JIT_CACHE) >= _PROBE_JIT_CACHE_MAX:
+                _PROBE_JIT_CACHE.pop(next(iter(_PROBE_JIT_CACHE)))
+            probe = _PROBE_JIT_CACHE[key] = probe_many
 
         row_cache: Dict[str, object] = {}
         delta_cache: dict = {}
@@ -794,10 +858,7 @@ class Simulator:
                     )
                     for node, remaining in chunk
                 ]
-                stacked = {
-                    k: np.stack([c[k] for c in col_list])
-                    for k in col_list[0]
-                }
+                stacked = np.stack(col_list)   # f32[lanes, T] packed columns
                 # pad the lane axis to a power-of-FOUR bucket (4/16/64/256):
                 # each distinct lane count would otherwise compile its own
                 # vmapped run_filters executable, and the compiles dominate
@@ -808,15 +869,15 @@ class Simulator:
                     c_pad *= 4
                 if c_pad != c:
                     nis = np.concatenate([nis, np.repeat(nis[:1], c_pad - c)])
-                    stacked = {
-                        k: np.concatenate(
-                            [v, np.repeat(v[:1], c_pad - c, axis=0)]
-                        )
-                        for k, v in stacked.items()
-                    }
-                res = self._probe_fit_many_jit(
+                    stacked = np.concatenate(
+                        [stacked, np.repeat(stacked[:1], c_pad - c, axis=0)]
+                    )
+                # dispatch through the locally-resolved probe: a fits_many
+                # closure must keep the jit whose offsets match the columns
+                # IT builds, even if a later rebuild resolved a newer one
+                res = probe(
                     self._ns, self._carry, row, jnp.asarray(nis),
-                    {k: jnp.asarray(v) for k, v in stacked.items()}, fo,
+                    jnp.asarray(stacked), fo,
                 )
                 out.extend(bool(b) for b in np.asarray(res)[:c])
             return out

@@ -1,0 +1,455 @@
+"""Systematic Go-template / Helm-engine semantics tables.
+
+The scaffold golden in test_chart.py is a snapshot of this engine's own
+output; this suite pins the *semantics* construct by construct against
+hand-derived Go text/template + sprig behavior (no helm binary exists in
+this environment), so drift in any one rule fails a named case rather than
+a wall of golden diff. Parity targets: Go text/template (text/template/doc),
+Masterminds/sprig v3 as vendored by Helm, and Helm's value-merge rules
+(vendor/helm.sh/helm/v3/pkg/chartutil/coalesce.go) as exercised by
+/root/reference/pkg/chart/chart.go:80-118.
+"""
+
+import os
+
+import pytest
+import yaml
+
+from open_simulator_tpu.utils.chart import (
+    ChartError,
+    process_chart,
+    render_template,
+)
+
+CTX = {
+    "Values": {
+        "s": "hello",
+        "n": 7,
+        "f": 2.5,
+        "z": 0,
+        "empty": "",
+        "t": True,
+        "fa": False,
+        "list": ["a", "b", "c"],
+        "map": {"x": 1, "y": 2},
+        "nested": {"deep": {"leaf": "v"}},
+    },
+    "Release": {"Name": "rel", "Namespace": "ns"},
+    "Chart": {"Name": "c", "Version": "1.0"},
+}
+
+
+def r(src: str) -> str:
+    return render_template(src, CTX)
+
+
+# ---------------------------------------------------------------------------
+# 1. whitespace chomping matrix ({{- and -}} against spaces/newlines/text)
+# ---------------------------------------------------------------------------
+
+CHOMP_CASES = [
+    # (template, expected) — '-' trims ALL adjacent whitespace incl. newlines
+    ("a {{ .Values.s }} b", "a hello b"),
+    ("a {{- .Values.s }} b", "ahello b"),
+    ("a {{ .Values.s -}} b", "a hellob"),
+    ("a {{- .Values.s -}} b", "ahellob"),
+    ("a\n{{- .Values.s }}\nb", "ahello\nb"),
+    ("a\n{{ .Values.s -}}\nb", "a\nhellob"),
+    ("a\n\n  {{- .Values.s }}", "ahello"),
+    ("{{ .Values.s -}}\n\n\nb", "hellob"),
+    ("a\t{{- .Values.s }}", "ahello"),
+    ("{{ .Values.s -}}\t b", "hellob"),
+    # markers eat the newlines themselves: a falsy if with -}} glues lines
+    ("a\n{{- if .Values.fa }}x{{ end -}}\nb", "ab"),
+    ("a\n  {{- if .Values.t -}}\nx\n  {{- end -}}\nb", "axb"),
+    # chomping composes across consecutive actions
+    ("{{ .Values.s -}} {{- .Values.s }}", "hellohello"),
+    # no marker: whitespace preserved exactly
+    ("a\n  {{ if .Values.fa }}x{{ end }}\nb", "a\n  \nb"),
+    # comments chomp the same way
+    ("a\n{{- /* note */}}\nb", "a\nb"),
+    ("a {{/* note */ -}} b", "a b"),
+]
+
+
+@pytest.mark.parametrize("src,want", CHOMP_CASES, ids=range(len(CHOMP_CASES)))
+def test_chomp(src, want):
+    assert r(src) == want
+
+
+# ---------------------------------------------------------------------------
+# 2. printf verb / coercion table (Go fmt.Sprintf subset charts use)
+# ---------------------------------------------------------------------------
+
+PRINTF_CASES = [
+    ('{{ printf "%s" .Values.s }}', "hello"),
+    ('{{ printf "%s-%d" .Values.s .Values.n }}', "hello-7"),
+    ('{{ printf "%d" 42 }}', "42"),
+    ('{{ printf "%05d" 42 }}', "00042"),
+    ('{{ printf "%x" 255 }}', "ff"),
+    ('{{ printf "%X" 255 }}', "FF"),
+    ('{{ printf "%o" 8 }}', "10"),
+    ('{{ printf "%b" 5 }}', "101"),
+    ('{{ printf "%f" 2.5 }}', "2.500000"),
+    ('{{ printf "%.2f" 2.5 }}', "2.50"),
+    ('{{ printf "%g" 2.5 }}', "2.5"),
+    ('{{ printf "%e" 1250.0 }}', "1.250000e+03"),
+    ('{{ printf "%q" .Values.s }}', '"hello"'),
+    ('{{ printf "%q" "a\\"b" }}', '"a\\"b"'),
+    ('{{ printf "%v" 7 }}', "7"),
+    ('{{ printf "%v" true }}', "true"),
+    ('{{ printf "%t" true }}', "true"),
+    ('{{ printf "%c" 65 }}', "A"),
+    ('{{ printf "%%" }}', "%"),
+    ('{{ printf "%-4d|" 7 }}', "7   |"),
+    ('{{ printf "%8s|" "ab" }}', "      ab|"),
+    # float -> %d truncates like Go's int conversion in sprig pipelines
+    ('{{ printf "%d" (int 2.9) }}', "2"),
+]
+
+
+@pytest.mark.parametrize("src,want", PRINTF_CASES, ids=range(len(PRINTF_CASES)))
+def test_printf(src, want):
+    assert r(src) == want
+
+
+def test_printf_error_cases():
+    with pytest.raises(ChartError, match="not enough arguments"):
+        r('{{ printf "%s %s" "a" }}')
+
+
+# ---------------------------------------------------------------------------
+# 3. nil / missing-key navigation
+# ---------------------------------------------------------------------------
+
+NIL_CASES = [
+    # missing map keys render empty, and navigation THROUGH one stays empty
+    ("{{ .Values.missing }}", ""),
+    ("{{ .Values.missing.deeper.still }}", ""),
+    ("{{ .Values.nested.deep.leaf }}", "v"),
+    ("{{ .Values.nested.nope.leaf }}", ""),
+    # default catches empty/missing/zero (sprig truthiness)
+    ('{{ .Values.missing | default "d" }}', "d"),
+    ('{{ .Values.empty | default "d" }}', "d"),
+    ('{{ .Values.z | default "d" }}', "d"),
+    ('{{ .Values.fa | default "d" }}', "d"),
+    ('{{ .Values.s | default "d" }}', "hello"),
+    # hasKey distinguishes absent from falsy
+    ("{{ hasKey .Values \"z\" }}", "true"),
+    ("{{ hasKey .Values \"missing\" }}", "false"),
+    # empty/coalesce
+    ("{{ empty .Values.empty }}", "true"),
+    ("{{ empty .Values.s }}", "false"),
+    ('{{ coalesce .Values.missing .Values.empty .Values.s "x" }}', "hello"),
+    # nil literal renders as Go's "<no value>"-less empty in Helm pipelines
+    ('{{ eq .Values.missing nil }}', "true"),
+    # index on missing key yields empty, not a crash
+    ('{{ index .Values "missing" }}', ""),
+    ('{{ index .Values.map "x" }}', "1"),
+    # kindOf nil
+    ("{{ kindOf .Values.missing }}", "invalid"),
+]
+
+
+@pytest.mark.parametrize("src,want", NIL_CASES, ids=range(len(NIL_CASES)))
+def test_nil_navigation(src, want):
+    assert r(src) == want
+
+
+# ---------------------------------------------------------------------------
+# 4. variable scoping in range / with / if-else
+# ---------------------------------------------------------------------------
+
+SCOPE_CASES = [
+    # $x declared outside survives a block; redeclared inside shadows it
+    ('{{ $x := "o" }}{{ if .Values.t }}{{ $x = "i" }}{{ end }}{{ $x }}', "i"),
+    ('{{ $x := "o" }}{{ if .Values.t }}{{ $x := "i" }}{{ $x }}{{ end }}{{ $x }}', "io"),
+    # range var is block-scoped
+    ("{{ range $v := .Values.list }}{{ $v }}{{ end }}", "abc"),
+    ("{{ range $i, $v := .Values.list }}{{ $i }}{{ $v }}{{ end }}", "0a1b2c"),
+    # dot rebinds inside range/with; $ stays the root
+    ("{{ range .Values.list }}{{ . }}{{ end }}", "abc"),
+    ("{{ range .Values.list }}{{ $.Release.Name }}{{ end }}", "relrelrel"),
+    ("{{ with .Values.nested }}{{ .deep.leaf }}{{ end }}", "v"),
+    ("{{ with .Values.nested }}{{ $.Values.s }}{{ end }}", "hello"),
+    # with on empty value takes else; dot stays original there
+    ('{{ with .Values.empty }}x{{ else }}{{ .Values.s }}{{ end }}', "hello"),
+    ("{{ with .Values.missing }}x{{ end }}", ""),
+    # range over a map iterates keys sorted (Go template guarantees order)
+    ("{{ range $k, $v := .Values.map }}{{ $k }}={{ $v }};{{ end }}", "x=1;y=2;"),
+    # range else on empty list
+    ('{{ range .Values.nope }}x{{ else }}none{{ end }}', "none"),
+    # mutation of an outer var inside range persists after it (Go 1.11+ '=')
+    ('{{ $n := 0 }}{{ range .Values.list }}{{ $n = add $n 1 }}{{ end }}{{ $n }}', "3"),
+    # nested ranges each get their own scope
+    (
+        "{{ range $a := .Values.list }}{{ range $b := $.Values.list }}"
+        "{{ $a }}{{ $b }}|{{ end }}{{ end }}",
+        "aa|ab|ac|ba|bb|bc|ca|cb|cc|",
+    ),
+    # if does NOT rebind dot
+    ("{{ if .Values.t }}{{ .Values.s }}{{ end }}", "hello"),
+]
+
+
+@pytest.mark.parametrize("src,want", SCOPE_CASES, ids=range(len(SCOPE_CASES)))
+def test_scoping(src, want):
+    assert r(src) == want
+
+
+# ---------------------------------------------------------------------------
+# 5. misc sprig coercions charts lean on
+# ---------------------------------------------------------------------------
+
+MISC_CASES = [
+    ('{{ ternary "y" "n" .Values.t }}', "y"),
+    ('{{ ternary "y" "n" .Values.fa }}', "n"),
+    ("{{ add 1 2 }}", "3"),
+    ("{{ sub 5 2 }}", "3"),
+    ("{{ div 7 2 }}", "3"),       # Go integer division truncates
+    ("{{ mod 7 2 }}", "1"),
+    ("{{ max 3 9 1 }}", "9"),
+    ("{{ min 3 9 1 }}", "1"),
+    ('{{ trunc 3 "abcdef" }}', "abc"),
+    ('{{ trunc -3 "abcdef" }}', "def"),
+    ('{{ trimSuffix "-" "a-" }}', "a"),
+    ('{{ trimPrefix "-" "-a" }}', "a"),
+    ('{{ replace " " "-" "a b c" }}', "a-b-c"),
+    ('{{ contains "ell" .Values.s }}', "true"),
+    ('{{ hasPrefix "he" .Values.s }}', "true"),
+    ('{{ .Values.s | upper }}', "HELLO"),
+    ('{{ "A B c" | lower }}', "a b c"),
+    ('{{ join "," .Values.list }}', "a,b,c"),
+    ('{{ splitList "," "a,b" | len }}', "2"),
+    ("{{ len .Values.list }}", "3"),
+    ("{{ first .Values.list }}", "a"),
+    ("{{ last .Values.list }}", "c"),
+    ('{{ .Values.n | toString }}', "7"),
+    ('{{ "7" | int }}', "7"),
+    ("{{ int64 2.9 }}", "2"),
+    ('{{ float64 "2.5" }}', "2.5"),
+    ('{{ list "a" "b" | len }}', "2"),
+    # toJson is Go json.Marshal: compact, no spaces
+    ('{{ dict "k" "v" | toJson }}', '{"k":"v"}'),
+    ("{{ .Values.map | toJson }}", '{"x":1,"y":2}'),
+    # toYaml + nindent: the bread-and-butter resources block
+    (
+        "x:\n{{- .Values.map | toYaml | nindent 2 }}",
+        "x:\n  x: 1\n  y: 2",
+    ),
+    ('{{ "s" | quote }}', '"s"'),
+    ("{{ .Values.n | quote }}", '"7"'),
+    ('{{ "s" | squote }}', "'s'"),
+    ('{{ b64enc "hi" }}', "aGk="),
+    ('{{ b64dec "aGk=" }}', "hi"),
+    ('{{ sha256sum "" }}',
+     "e3b0c44298fc1c149afbf4c8996fb92427ae41e4649b934ca495991b7852b855"),
+    # boolean operators are functions
+    ("{{ and .Values.t .Values.s }}", "hello"),
+    ("{{ or .Values.empty .Values.s }}", "hello"),
+    ("{{ not .Values.t }}", "false"),
+    ("{{ eq .Values.n 7 }}", "true"),
+    ("{{ ne .Values.n 8 }}", "true"),
+    ("{{ lt 1 2 }}", "true"),
+    ("{{ ge 2 2 }}", "true"),
+]
+
+
+@pytest.mark.parametrize("src,want", MISC_CASES, ids=range(len(MISC_CASES)))
+def test_misc_functions(src, want):
+    assert r(src) == want
+
+
+# ---------------------------------------------------------------------------
+# 6. unknown constructs fail loudly with the offending name
+# ---------------------------------------------------------------------------
+
+def test_unknown_function_names_the_function():
+    with pytest.raises(ChartError, match="frobnicate"):
+        r("{{ frobnicate .Values.s }}")
+    with pytest.raises(ChartError, match="notAThing"):
+        r("{{ .Values.s | notAThing }}")
+    # nondeterminism is rejected by design, naming the function
+    with pytest.raises(ChartError, match="randAlphaNum"):
+        r("{{ randAlphaNum 8 }}")
+    with pytest.raises(ChartError, match="uuidv4"):
+        r("{{ uuidv4 }}")
+
+
+def test_lookup_returns_empty_like_helm_template():
+    # helm template / install --dry-run: lookup always yields an empty map
+    assert r('{{ lookup "v1" "Pod" "ns" "n" }}') in ("{}", "map[]")
+
+
+def test_required_fails_with_message():
+    with pytest.raises(ChartError, match="replica count is required"):
+        r('{{ required "replica count is required" .Values.missing }}')
+    assert r('{{ required "msg" .Values.s }}') == "hello"
+
+
+# ---------------------------------------------------------------------------
+# 7. subchart value precedence (Helm coalesce rules) incl. global collisions
+# ---------------------------------------------------------------------------
+
+def _write_chart(tmp_path, name, values, templates, sub=None):
+    d = tmp_path / name
+    (d / "templates").mkdir(parents=True)
+    (d / "Chart.yaml").write_text(f"apiVersion: v2\nname: {name}\nversion: 1.0.0\n")
+    (d / "values.yaml").write_text(yaml.safe_dump(values))
+    for fname, body in templates.items():
+        (d / "templates" / fname).write_text(body)
+    if sub:
+        for s in sub:
+            os.rename(str(s), str(d / "charts" / os.path.basename(s)))
+    return d
+
+
+def _mk_sub(tmp_path, parent_dir, name, values, templates):
+    charts = parent_dir / "charts"
+    charts.mkdir(exist_ok=True)
+    d = charts / name
+    (d / "templates").mkdir(parents=True)
+    (d / "Chart.yaml").write_text(f"apiVersion: v2\nname: {name}\nversion: 1.0.0\n")
+    (d / "values.yaml").write_text(yaml.safe_dump(values))
+    for fname, body in templates.items():
+        (d / "templates" / fname).write_text(body)
+    return d
+
+
+CM = (
+    "apiVersion: v1\nkind: ConfigMap\nmetadata:\n  name: {name}\n"
+    "data:\n  v: {expr}\n"
+)
+
+
+def test_subchart_value_precedence(tmp_path):
+    """Parent values.yaml's <subchart-name>: block overrides the subchart's
+    own defaults key-by-key; untouched subchart keys survive (chartutil
+    CoalesceValues)."""
+    parent = _write_chart(
+        tmp_path,
+        "parent",
+        {
+            "own": "p",
+            "sub": {"color": "from-parent"},   # overrides sub's default
+        },
+        {"p.yaml": CM.format(name="p", expr="{{ .Values.own | quote }}")},
+    )
+    _mk_sub(
+        tmp_path,
+        parent,
+        "sub",
+        {"color": "from-sub", "keep": "kept"},
+        {
+            "s.yaml": CM.format(
+                name="s",
+                expr='{{ printf "%s-%s" .Values.color .Values.keep | quote }}',
+            )
+        },
+    )
+    docs = process_chart(str(parent))
+    by_name = {d["metadata"]["name"]: d for d in docs}
+    assert by_name["p"]["data"]["v"] == "p"
+    # parent override won, untouched key survived
+    assert by_name["s"]["data"]["v"] == "from-parent-kept"
+
+
+def test_global_values_visible_everywhere(tmp_path):
+    """.Values.global flows into every subchart; a subchart's own global
+    default loses to the parent's on collision (Helm: parent wins)."""
+    parent = _write_chart(
+        tmp_path,
+        "parent",
+        {"global": {"region": "eu", "tier": "gold"}},
+        {
+            "p.yaml": CM.format(
+                name="p", expr="{{ .Values.global.region | quote }}"
+            )
+        },
+    )
+    _mk_sub(
+        tmp_path,
+        parent,
+        "sub",
+        {"global": {"region": "us", "zone": "z1"}},
+        {
+            "s.yaml": CM.format(
+                name="s",
+                expr=(
+                    '{{ printf "%s/%s/%s" .Values.global.region '
+                    ".Values.global.tier .Values.global.zone | quote }}"
+                ),
+            )
+        },
+    )
+    docs = process_chart(str(parent))
+    by_name = {d["metadata"]["name"]: d for d in docs}
+    assert by_name["p"]["data"]["v"] == "eu"
+    # parent's region beats sub's; parent-only tier visible; sub-only zone kept
+    assert by_name["s"]["data"]["v"] == "eu/gold/z1"
+
+
+def test_subchart_sees_own_slice_not_parent(tmp_path):
+    """Inside a subchart, .Values IS the subchart slice (plus global) — the
+    parent's unrelated keys are invisible."""
+    parent = _write_chart(
+        tmp_path,
+        "parent",
+        {"secret": "parent-only", "sub": {}},
+        {"p.yaml": CM.format(name="p", expr='"x"')},
+    )
+    _mk_sub(
+        tmp_path,
+        parent,
+        "sub",
+        {},
+        {
+            "s.yaml": CM.format(
+                name="s", expr='{{ .Values.secret | default "unseen" | quote }}'
+            )
+        },
+    )
+    docs = process_chart(str(parent))
+    by_name = {d["metadata"]["name"]: d for d in docs}
+    assert by_name["s"]["data"]["v"] == "unseen"
+
+
+# ---------------------------------------------------------------------------
+# 8. the shipped stackd chart renders to a pinned golden (second end-to-end
+#    chart beside the reference's yoda chart in test_chart.py)
+# ---------------------------------------------------------------------------
+
+def test_stackd_chart_golden():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    docs = process_chart(
+        os.path.join(root, "example", "application", "charts", "stackd"),
+        release_name="stackd",
+    )
+    kinds = [d["kind"] for d in docs]
+    # Helm InstallOrder: ConfigMap, then DaemonSet BEFORE Deployment
+    assert kinds == ["ConfigMap", "DaemonSet", "Deployment"]
+    cm, ds, deploy = docs
+    assert cm["metadata"]["name"] == "stackd-stackd-config"
+    assert cm["data"] == {"logLevel": "info", "flushSeconds": "30"}
+    assert deploy["spec"]["replicas"] == 2
+    assert (
+        deploy["spec"]["template"]["spec"]["containers"][0]["image"]
+        == "registry.acme.io/stackd/controller:1.7"
+    )
+    assert (
+        deploy["metadata"]["labels"]["app.kubernetes.io/version"] == "1.7"
+    )
+    tol = ds["spec"]["template"]["spec"]["tolerations"]
+    assert tol == [
+        {
+            "key": "node-role.kubernetes.io/master",
+            "operator": "Exists",
+            "effect": "NoSchedule",
+        }
+    ]
+    assert (
+        ds["spec"]["template"]["spec"]["containers"][0]["resources"][
+            "requests"
+        ]
+        == {"cpu": "200m", "memory": "256Mi"}
+    )
