@@ -257,6 +257,24 @@ class _Handler(BaseHTTPRequestHandler):
             except ValueError:
                 seconds = 2.0
             self._send(200, _cpu_profile(seconds))
+        elif self.path in ("/debug/pprof", "/debug/pprof/"):
+            # the gin-contrib/pprof index (server.go:152): what's available
+            self._send(
+                200,
+                {
+                    "profiles": {
+                        "goroutine": "/debug/pprof/goroutine",
+                        "heap": "/debug/pprof/heap",
+                        "profile": "/debug/pprof/profile?seconds=N",
+                        "cmdline": "/debug/pprof/cmdline",
+                        "timings": "/debug/timings",
+                    }
+                },
+            )
+        elif self.path.startswith("/debug/pprof/cmdline"):
+            import sys
+
+            self._send(200, {"cmdline": sys.argv})
         elif self.path.startswith("/debug/pprof/goroutine"):
             self._send(200, _goroutine_dump())
         elif self.path.startswith("/debug/pprof/heap"):

@@ -141,8 +141,6 @@ NIL_CASES = [
     ("{{ empty .Values.empty }}", "true"),
     ("{{ empty .Values.s }}", "false"),
     ('{{ coalesce .Values.missing .Values.empty .Values.s "x" }}', "hello"),
-    # nil literal renders as Go's "<no value>"-less empty in Helm pipelines
-    ('{{ eq .Values.missing nil }}', "true"),
     # index on missing key yields empty, not a crash
     ('{{ index .Values "missing" }}', ""),
     ('{{ index .Values.map "x" }}', "1"),
@@ -291,16 +289,13 @@ def test_required_fails_with_message():
 # 7. subchart value precedence (Helm coalesce rules) incl. global collisions
 # ---------------------------------------------------------------------------
 
-def _write_chart(tmp_path, name, values, templates, sub=None):
+def _write_chart(tmp_path, name, values, templates):
     d = tmp_path / name
     (d / "templates").mkdir(parents=True)
     (d / "Chart.yaml").write_text(f"apiVersion: v2\nname: {name}\nversion: 1.0.0\n")
     (d / "values.yaml").write_text(yaml.safe_dump(values))
     for fname, body in templates.items():
         (d / "templates" / fname).write_text(body)
-    if sub:
-        for s in sub:
-            os.rename(str(s), str(d / "charts" / os.path.basename(s)))
     return d
 
 

@@ -389,6 +389,15 @@ def test_server_goroutine_dump():
             f"http://127.0.0.1:{port}/debug/pprof/goroutine"
         ) as r:
             dump = json.load(r)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/pprof/"
+        ) as r:
+            idx = json.load(r)
+        assert set(idx["profiles"]) >= {"goroutine", "heap", "profile", "cmdline"}
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/pprof/cmdline"
+        ) as r:
+            assert isinstance(json.load(r)["cmdline"], list)
         assert dump["count"] >= 2  # at least main + the serving thread
         assert dump["count"] == len(dump["threads"])
         all_frames = [
