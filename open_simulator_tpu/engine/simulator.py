@@ -507,7 +507,10 @@ class Simulator:
 
         with span("encode", pods=len(pods)):
             batch = encode_pods(self.enc, pods)
-            rows = pod_rows_from_batch(batch)
+            # host-side row table: per-pod slicing below is numpy (free);
+            # sliced straight off device arrays it was ~40 un-jitted device
+            # gets PER POD, which dominated the whole extender path
+            rows = jax.tree.map(np.asarray, pod_rows_from_batch(batch))
         fo = None if filter_on is None else jnp.asarray(filter_on)
         failed: List[UnscheduledPod] = []
         n_nodes = len(self.cluster.nodes)
@@ -839,8 +842,10 @@ class Simulator:
             row = row_cache.get(pod.key)
             if row is None:
                 batch = encode_pods(self.enc, [pod])
+                # slice on host: a device-array [0] per field is ~40
+                # un-jitted gets per preemptor
                 row = jax.tree.map(
-                    lambda a: a[0], pod_rows_from_batch(batch)
+                    lambda a: np.asarray(a)[0], pod_rows_from_batch(batch)
                 )
                 row_cache[pod.key] = row
             out: List[bool] = []

@@ -645,6 +645,13 @@ class _Renderer:
         if fn == "not":
             return not _truthy(args[0])
         if fn in ("eq", "ne", "lt", "le", "gt", "ge"):
+            # Go text/template: a nil operand has no basicKind — every
+            # comparison against it is an execution error ("invalid type
+            # for comparison"), it does NOT compare equal-to-missing
+            if any(a is None for a in args):
+                raise ChartError(
+                    f"{fn}: invalid type for comparison (nil operand)"
+                )
             a = args[0]
             try:
                 if fn == "eq":

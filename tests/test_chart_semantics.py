@@ -141,6 +141,8 @@ NIL_CASES = [
     ("{{ empty .Values.empty }}", "true"),
     ("{{ empty .Values.s }}", "false"),
     ('{{ coalesce .Values.missing .Values.empty .Values.s "x" }}', "hello"),
+    # kindIs is the Helm-sanctioned nil test (eq-against-nil errors, below)
+    ('{{ kindIs "invalid" .Values.missing }}', "true"),
     # index on missing key yields empty, not a crash
     ('{{ index .Values "missing" }}', ""),
     ('{{ index .Values.map "x" }}', "1"),
@@ -272,6 +274,19 @@ def test_unknown_function_names_the_function():
         r("{{ randAlphaNum 8 }}")
     with pytest.raises(ChartError, match="uuidv4"):
         r("{{ uuidv4 }}")
+
+
+def test_nil_comparison_errors_like_go():
+    """Go text/template: eq/ne/lt/... with a nil operand is an execution
+    error ('invalid type for comparison'), not a truthy/falsy result."""
+    for src in (
+        "{{ eq .Values.missing nil }}",
+        "{{ eq nil nil }}",
+        "{{ ne .Values.missing 1 }}",
+        "{{ lt .Values.missing 1 }}",
+    ):
+        with pytest.raises(ChartError, match="invalid type for comparison"):
+            r(src)
 
 
 def test_lookup_returns_empty_like_helm_template():
