@@ -501,7 +501,7 @@ class Simulator:
         import jax.numpy as jnp
 
         from ..ops.kernels import commit_step, probe_step
-        from ..ops.state import pod_rows_from_batch
+        from ..ops.state import pod_rows_from_batch_host
         from ..utils.tracing import log
         from .extenders import EXTENDER_SCORE_SCALE, ExtenderError
 
@@ -510,7 +510,7 @@ class Simulator:
             # host-side row table: per-pod slicing below is numpy (free);
             # sliced straight off device arrays it was ~40 un-jitted device
             # gets PER POD, which dominated the whole extender path
-            rows = jax.tree.map(np.asarray, pod_rows_from_batch(batch))
+            rows = pod_rows_from_batch_host(batch)
         fo = None if filter_on is None else jnp.asarray(filter_on)
         failed: List[UnscheduledPod] = []
         n_nodes = len(self.cluster.nodes)
@@ -774,7 +774,7 @@ class Simulator:
 
         from ..ops.encode import encode_pods
         from ..ops.kernels import run_filters
-        from ..ops.state import pod_rows_from_batch
+        from ..ops.state import pod_rows_from_batch_host
 
         # One jitted probe per (out-of-tree filter set, packed layout),
         # cached at module level: a per-Simulator closure would retrace +
@@ -842,10 +842,10 @@ class Simulator:
             row = row_cache.get(pod.key)
             if row is None:
                 batch = encode_pods(self.enc, [pod])
-                # slice on host: a device-array [0] per field is ~40
-                # un-jitted gets per preemptor
+                # host rows: slicing device arrays is ~40 un-jitted
+                # gets per preemptor
                 row = jax.tree.map(
-                    lambda a: np.asarray(a)[0], pod_rows_from_batch(batch)
+                    lambda a: a[0], pod_rows_from_batch_host(batch)
                 )
                 row_cache[pod.key] = row
             out: List[bool] = []

@@ -109,6 +109,21 @@ def carry_from_table(
     )
 
 
+def pod_rows_from_batch_host(batch: PodBatch) -> PodRow:
+    """Stacked PodRow pytree with HOST numpy leaves — for per-pod drivers
+    (extender path, preemption probe rows) that slice one row at a time:
+    slicing device arrays costs an un-jitted device get per field per pod,
+    and round-tripping jnp→np pays ~40 transfers each way for data that
+    starts and ends as numpy. The field set mirrors pod_rows_from_batch."""
+    import numpy as _np
+
+    # PodRow fields map 1:1 onto PodBatch attributes of the same name
+    # (exactly what pod_rows_from_batch relies on below)
+    return PodRow(
+        **{f: _np.asarray(getattr(batch, f)) for f in PodRow._fields}
+    )
+
+
 def pod_rows_from_batch(batch: PodBatch) -> PodRow:
     """Stacked PodRow pytree ([P, ...] leaves) for lax.scan."""
     return PodRow(

@@ -645,23 +645,30 @@ class _Renderer:
         if fn == "not":
             return not _truthy(args[0])
         if fn in ("eq", "ne", "lt", "le", "gt", "ge"):
-            # Go text/template: a nil operand has no basicKind — every
-            # comparison against it is an execution error ("invalid type
-            # for comparison"), it does NOT compare equal-to-missing
-            if any(a is None for a in args):
-                raise ChartError(
-                    f"{fn}: invalid type for comparison (nil operand)"
-                )
+            # Go text/template basicKind semantics (funcs.go): nil and
+            # non-basic values (maps, slices) have no comparison kind —
+            # "invalid type for comparison"; mismatched kinds (int vs
+            # string, int vs float) are "incompatible types for
+            # comparison"; ordering additionally rejects bools. None of
+            # these silently compare false the way loose Python would.
+            kinds = [_basic_kind(a) for a in args]
+            if any(k is None for k in kinds):
+                raise ChartError(f"{fn}: invalid type for comparison")
             a = args[0]
-            try:
-                if fn == "eq":
-                    return any(a == b for b in args[1:])
-                if fn == "ne":
-                    return a != args[1]
-                b = args[1]
-                return {"lt": a < b, "le": a <= b, "gt": a > b, "ge": a >= b}[fn]
-            except TypeError:
-                return False
+            if fn == "eq":
+                if any(k != kinds[0] for k in kinds[1:]):
+                    raise ChartError(
+                        f"{fn}: incompatible types for comparison"
+                    )
+                return any(a == b for b in args[1:])
+            if kinds[0] != kinds[1]:
+                raise ChartError(f"{fn}: incompatible types for comparison")
+            if fn == "ne":
+                return a != args[1]
+            if kinds[0] == "bool":
+                raise ChartError(f"{fn}: invalid type for comparison")
+            b = args[1]
+            return {"lt": a < b, "le": a <= b, "gt": a > b, "ge": a >= b}[fn]
         if fn == "and":
             out = args[0]
             for a in args:
@@ -1135,6 +1142,16 @@ def _glob_regex(pat: str):
             out.append(re.escape(c))
             i += 1
     return re.compile("^" + "".join(out) + "$")
+
+
+def _basic_kind(v: Any) -> Optional[str]:
+    """text/template funcs.go basicKind: the comparison kind of a value, or
+    None for nil and non-basic values (maps, slices) — bool checked before
+    int because isinstance(True, int) holds in Python."""
+    for t, k in ((bool, "bool"), (int, "int"), (float, "float"), (str, "string")):
+        if isinstance(v, t):
+            return k
+    return None
 
 
 def _go_kind(v: Any) -> str:

@@ -284,9 +284,30 @@ def test_nil_comparison_errors_like_go():
         "{{ eq nil nil }}",
         "{{ ne .Values.missing 1 }}",
         "{{ lt .Values.missing 1 }}",
+        "{{ eq .Values.list .Values.list }}",   # slices are not basic kinds
     ):
         with pytest.raises(ChartError, match="invalid type for comparison"):
             r(src)
+
+
+def test_mismatched_kind_comparison_errors_like_go():
+    """basicKind mismatch (int vs string, int vs float) is 'incompatible
+    types for comparison' in Go — never a silent false."""
+    for src in (
+        '{{ eq 1 "1" }}',
+        '{{ lt .Values.n "2" }}',
+        "{{ eq 1 1.0 }}",
+        '{{ ne .Values.s 3 }}',
+    ):
+        with pytest.raises(ChartError, match="incompatible types"):
+            r(src)
+    # ordering bools is 'invalid type for comparison'
+    with pytest.raises(ChartError, match="invalid type for comparison"):
+        r("{{ lt true false }}")
+    # same-kind comparisons still work
+    assert r("{{ eq 1 1 }}") == "true"
+    assert r('{{ lt "a" "b" }}') == "true"
+    assert r("{{ eq true .Values.t }}") == "true"
 
 
 def test_lookup_returns_empty_like_helm_template():

@@ -736,6 +736,40 @@ def test_preempt_only_extender_config_accepted(tmp_path):
     assert cfg.extenders[0].preempt_verb == "preempt"
 
 
+def test_capacity_plan_honors_extender(stub_factory):
+    """The capacity search must evaluate every probe through the extender
+    chain: an extender that only admits the candidate-node template forces
+    the plan to add nodes for ALL pods instead of using existing capacity
+    (plan probes run the same WithExtenders engine, simulator.go:211-216)."""
+    from open_simulator_tpu.engine.capacity import plan_capacity
+
+    # candidate clones are named simon-NNNNN (AddNodesToCluster parity)
+    stub = stub_factory({"allow": {f"simon-{i:05d}" for i in range(16)}})
+    cluster = ClusterResource(nodes=_nodes(2, cpu="16"))  # plenty of room...
+    apps = [AppResource(name="a", objects=[_deploy(replicas=4, cpu="4")])]
+    template = Node.from_dict(
+        {
+            "metadata": {
+                "name": "new",
+                "labels": {"kubernetes.io/hostname": "new"},
+            },
+            "status": {
+                "allocatable": {"cpu": "8", "memory": "32Gi", "pods": "110"}
+            },
+        }
+    )
+    plan = plan_capacity(
+        cluster, apps, template, extenders=[_ext(stub.url)],
+    )
+    # ...but the extender denies n0/n1, so pods only fit on added nodes
+    assert plan.nodes_added >= 2
+    assert not plan.result.unscheduled
+    placed_nodes = {
+        st.node.name for st in plan.result.node_status if st.pods
+    }
+    assert all(n.startswith("simon-") for n in placed_nodes)
+
+
 def test_preemption_retry_honors_extender_filter(stub_factory):
     """A preemptor that needs an eviction AND is gated by an extender: the
     post-eviction retry goes back through the extender path, so the pod may
