@@ -118,58 +118,15 @@ def pod_rows_from_batch_host(batch: PodBatch) -> PodRow:
     import numpy as _np
 
     # PodRow fields map 1:1 onto PodBatch attributes of the same name
-    # (exactly what pod_rows_from_batch relies on below)
     return PodRow(
         **{f: _np.asarray(getattr(batch, f)) for f in PodRow._fields}
     )
 
 
 def pod_rows_from_batch(batch: PodBatch) -> PodRow:
-    """Stacked PodRow pytree ([P, ...] leaves) for lax.scan."""
+    """Stacked PodRow pytree ([P, ...] device leaves) for lax.scan."""
     return PodRow(
-        req=jnp.asarray(batch.req),
-        has_req=jnp.asarray(batch.has_req),
-        node_name_id=jnp.asarray(batch.node_name_id),
-        gpu_mem=jnp.asarray(batch.gpu_mem),
-        gpu_num=jnp.asarray(batch.gpu_num),
-        sel_op=jnp.asarray(batch.sel_op),
-        sel_key=jnp.asarray(batch.sel_key),
-        sel_val=jnp.asarray(batch.sel_val),
-        sel_num=jnp.asarray(batch.sel_num),
-        has_terms=jnp.asarray(batch.has_terms),
-        ns_pair=jnp.asarray(batch.ns_pair),
-        pref_weight=jnp.asarray(batch.pref_weight),
-        pref_op=jnp.asarray(batch.pref_op),
-        pref_key=jnp.asarray(batch.pref_key),
-        pref_val=jnp.asarray(batch.pref_val),
-        pref_num=jnp.asarray(batch.pref_num),
-        tol_key=jnp.asarray(batch.tol_key),
-        tol_val=jnp.asarray(batch.tol_val),
-        tol_exists=jnp.asarray(batch.tol_exists),
-        tol_effect=jnp.asarray(batch.tol_effect),
-        tol_valid=jnp.asarray(batch.tol_valid),
-        spread_topo=jnp.asarray(batch.spread_topo),
-        spread_sel=jnp.asarray(batch.spread_sel),
-        spread_skew=jnp.asarray(batch.spread_skew),
-        spread_hard=jnp.asarray(batch.spread_hard),
-        aff_topo=jnp.asarray(batch.aff_topo),
-        aff_sel=jnp.asarray(batch.aff_sel),
-        aff_anti=jnp.asarray(batch.aff_anti),
-        aff_required=jnp.asarray(batch.aff_required),
-        aff_weight=jnp.asarray(batch.aff_weight),
-        lvm_req=jnp.asarray(batch.lvm_req),
-        lvm_vg=jnp.asarray(batch.lvm_vg),
-        dev_req=jnp.asarray(batch.dev_req),
-        dev_media_ssd=jnp.asarray(batch.dev_media_ssd),
-        has_local=jnp.asarray(batch.has_local),
-        match_sel=jnp.asarray(batch.match_sel),
-        owned_by_rs=jnp.asarray(batch.owned_by_rs),
-        hp_pid=jnp.asarray(batch.hp_pid),
-        hp_wild=jnp.asarray(batch.hp_wild),
-        hp_ipid=jnp.asarray(batch.hp_ipid),
-        match_anti=jnp.asarray(batch.match_anti),
-        own_anti=jnp.asarray(batch.own_anti),
-        valid=jnp.asarray(batch.valid),
+        **{f: jnp.asarray(getattr(batch, f)) for f in PodRow._fields}
     )
 
 

@@ -320,9 +320,12 @@ def serve(
     kubeconfig: str = "",
     master: str = "",
 ) -> int:
-    global _kubeconfig, _master
+    global _kubeconfig, _master, _snapshot, _snapshot_at
     _kubeconfig = kubeconfig or None
     _master = master
+    # a previous serve() in this process may have cached a snapshot of a
+    # DIFFERENT cluster — never serve it against the new config
+    _snapshot, _snapshot_at = None, 0.0
     httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
     if ready is not None:
         ready.set()

@@ -651,23 +651,37 @@ class _Renderer:
             # string, int vs float) are "incompatible types for
             # comparison"; ordering additionally rejects bools. None of
             # these silently compare false the way loose Python would.
-            kinds = [_basic_kind(a) for a in args]
-            if any(k is None for k in kinds):
-                raise ChartError(f"{fn}: invalid type for comparison")
             a = args[0]
+            k1 = _basic_kind(a)
+            if k1 is None:
+                raise ChartError(f"{fn}: invalid type for comparison")
             if fn == "eq":
-                if any(k != kinds[0] for k in kinds[1:]):
-                    raise ChartError(
-                        f"{fn}: incompatible types for comparison"
-                    )
-                return any(a == b for b in args[1:])
-            if kinds[0] != kinds[1]:
+                # Go's eq loop short-circuits: it returns true at the first
+                # matching pair WITHOUT inspecting later args' kinds
+                # (funcs.go eq) — `eq 1 1 "x"` is true, `eq 1 "x" 1` errors
+                for b in args[1:]:
+                    k2 = _basic_kind(b)
+                    if k2 is None:
+                        raise ChartError(
+                            f"{fn}: invalid type for comparison"
+                        )
+                    if k1 != k2:
+                        raise ChartError(
+                            f"{fn}: incompatible types for comparison"
+                        )
+                    if a == b:
+                        return True
+                return False
+            b = args[1]
+            k2 = _basic_kind(b)
+            if k2 is None:
+                raise ChartError(f"{fn}: invalid type for comparison")
+            if k1 != k2:
                 raise ChartError(f"{fn}: incompatible types for comparison")
             if fn == "ne":
-                return a != args[1]
-            if kinds[0] == "bool":
+                return a != b
+            if k1 == "bool":
                 raise ChartError(f"{fn}: invalid type for comparison")
-            b = args[1]
             return {"lt": a < b, "le": a <= b, "gt": a > b, "ge": a >= b}[fn]
         if fn == "and":
             out = args[0]

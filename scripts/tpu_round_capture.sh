@@ -1,0 +1,49 @@
+#!/bin/bash
+# One-command TPU capture for the round's blocked item (VERDICT #1):
+# run this the moment the axon tunnel initializes (e.g. when the probe loop
+# has written /tmp/tpu_ready.json). It records, in order:
+#   1. the full bench (headline + all 7 configs) on the TPU backend
+#   2. the OSIM_PALLAS=1 oracle-parity suite (compiled mode, real TPU)
+#   3. a Pallas-vs-XLA timing A/B on the domain path
+# Results land in /tmp/tpu_capture/ — paste the numbers into BASELINE.md and
+# record the Pallas keep/delete decision there.
+set -u
+OUT=/tmp/tpu_capture
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+echo "== 1/3 bench (TPU) =="
+timeout 7200 python bench.py 2>"$OUT/bench.err" | tail -1 > "$OUT/bench_tpu.json"
+tail -c 400 "$OUT/bench_tpu.json"; echo
+
+echo "== 2/3 Pallas parity (compiled, real TPU) =="
+OSIM_PALLAS=1 timeout 1800 python -m pytest tests/test_fast.py -q -k domain \
+    > "$OUT/pallas_parity.txt" 2>&1
+tail -2 "$OUT/pallas_parity.txt"
+
+echo "== 3/3 Pallas timing A/B =="
+timeout 1800 python - <<'EOF' > "$OUT/pallas_timing.txt" 2>&1
+import os, time
+import numpy as np
+
+def run(pallas: bool):
+    os.environ["OSIM_PALLAS"] = "1" if pallas else "0"
+    # fresh process state matters for the env flag; this in-process A/B is
+    # valid only if ops.fast reads the flag per call — check and fall back
+    import importlib
+    import open_simulator_tpu.ops.fast as fast
+    importlib.reload(fast)
+    import bench
+    t0 = time.time()
+    out = bench._run_headline(20_000, 2_000)
+    return out
+
+a = run(False)
+print("XLA   :", a)
+b = run(True)
+print("PALLAS:", b)
+print("decision hint: keep Pallas iff bit-identical (suite above) AND "
+      "PALLAS wall_s < XLA wall_s")
+EOF
+tail -4 "$OUT/pallas_timing.txt"
+echo "== capture complete: $OUT =="

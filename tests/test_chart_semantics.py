@@ -304,6 +304,11 @@ def test_mismatched_kind_comparison_errors_like_go():
     # ordering bools is 'invalid type for comparison'
     with pytest.raises(ChartError, match="invalid type for comparison"):
         r("{{ lt true false }}")
+    # Go's eq short-circuits at the first matching pair — later args'
+    # kinds are never inspected; an earlier mismatch still errors
+    assert r('{{ eq 1 1 "x" }}') == "true"
+    with pytest.raises(ChartError, match="incompatible types"):
+        r('{{ eq 1 "x" 1 }}')
     # same-kind comparisons still work
     assert r("{{ eq 1 1 }}") == "true"
     assert r('{{ lt "a" "b" }}') == "true"
