@@ -13,7 +13,7 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import List, Optional, Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 
