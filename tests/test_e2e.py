@@ -374,6 +374,108 @@ def test_server_pprof_endpoints():
         server_mod._tracemalloc_on = False
 
 
+def test_server_scale_apps_roundtrip():
+    """POST /api/scale-apps: removeWorkloads drops the named workload's
+    bound pods from the snapshot before re-simulating at the new count
+    (removePodsOfApp parity, server.go:404-444)."""
+    from open_simulator_tpu.server.server import make_server
+
+    nodes = [
+        {
+            "kind": "Node",
+            "metadata": {
+                "name": f"s{i}",
+                "labels": {"kubernetes.io/hostname": f"s{i}"},
+            },
+            "status": {
+                "allocatable": {"cpu": "8", "memory": "16Gi", "pods": "110"}
+            },
+        }
+        for i in range(2)
+    ]
+    # two bound replicas of Deployment web (4 cpu each: the nodes are FULL)
+    bound = [
+        {
+            "kind": "Pod",
+            "metadata": {
+                "name": f"web-{i}",
+                "namespace": "d",
+                "labels": {"app": "web"},
+                "ownerReferences": [
+                    {"kind": "ReplicaSet", "name": "web-abc123"}
+                ],
+                "annotations": {
+                    "simon/workload-kind": "Deployment",
+                    "simon/workload-name": "web",
+                    "simon/workload-namespace": "d",
+                },
+            },
+            "spec": {
+                "nodeName": f"s{i}",
+                "containers": [
+                    {
+                        "name": "c",
+                        "image": "i",
+                        "resources": {"requests": {"cpu": "7"}},
+                    }
+                ],
+            },
+        }
+        for i in range(2)
+    ]
+    from tests.factories import make_deployment
+
+    scaled = make_deployment("web", replicas=3, namespace="d", cpu="4")
+    srv = make_server(0)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        body = json.dumps(
+            {
+                "cluster": {"objects": nodes + bound},
+                "apps": [{"name": "web", "objects": [scaled]}],
+                "removeWorkloads": [
+                    {"kind": "Deployment", "name": "web", "namespace": "d"}
+                ],
+            }
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/scale-apps",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as r:
+            out = json.load(r)
+        # old 7-cpu replicas removed -> three new 4-cpu replicas fit
+        # (impossible if the old pods still occupied the nodes)
+        assert out["unscheduled"] == []
+        assert len(out["placements"]) == 3
+        # the two REMOVED bound pods (exact keys) must be gone; new replica
+        # names carry random suffixes, so only exact matches are safe
+        assert "d/web-0" not in out["placements"]
+        assert "d/web-1" not in out["placements"]
+
+        # WITHOUT removeWorkloads the old pods stay and nothing fits
+        body2 = json.dumps(
+            {
+                "cluster": {"objects": nodes + bound},
+                "apps": [{"name": "web", "objects": [scaled]}],
+            }
+        ).encode()
+        req2 = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/deploy-apps",
+            data=body2,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req2) as r:
+            out2 = json.load(r)
+        assert len(out2["unscheduled"]) == 3
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
 def test_server_goroutine_dump():
     """/debug/pprof/goroutine: instantaneous all-thread stack dump (the
     goroutine-dump analog of server.go:152's pprof surface — the tool the
