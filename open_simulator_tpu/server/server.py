@@ -101,6 +101,18 @@ def _simulate_request(body: dict) -> dict:
         for w in body.get("removeWorkloads") or []
     }
     if removals:
+        # Deployment indirection (server.go:408-419): real-cluster pods of a
+        # Deployment are owned by its ReplicaSets, which the snapshot lists —
+        # an RS whose ownerReferences name a removed Deployment marks its own
+        # pods removable. (Simulated pods match directly via annotations.)
+        rs_of_removed = set()
+        for rs in cluster.others.get("ReplicaSet", []):
+            meta = rs.get("metadata") or {}
+            ns = meta.get("namespace", "default")
+            for ref in meta.get("ownerReferences") or []:
+                if (ref.get("kind", ""), ns, ref.get("name", "")) in removals:
+                    rs_of_removed.add((ns, meta.get("name", "")))
+
         def owned(pod) -> bool:
             ann = pod.meta.annotations
             key = (
@@ -108,7 +120,24 @@ def _simulate_request(body: dict) -> dict:
                 ann.get(ANNO_WORKLOAD_NAMESPACE, pod.meta.namespace),
                 ann.get(ANNO_WORKLOAD_NAME, pod.meta.owner_name),
             )
-            return key in removals
+            if key in removals:
+                return True
+            # OwnedByWorkload scans EVERY ownerReference (utils.go:840-853)
+            # — a multi-owner pod's RS/STS ref need not be listed first
+            refs = ((pod.raw or {}).get("metadata") or {}).get(
+                "ownerReferences"
+            ) or []
+            for ref in refs:
+                kind = ref.get("kind", "")
+                name = ref.get("name", "")
+                if (kind, pod.meta.namespace, name) in removals:
+                    return True
+                if (
+                    kind == "ReplicaSet"
+                    and (pod.meta.namespace, name) in rs_of_removed
+                ):
+                    return True
+            return False
 
         cluster.pods = [p for p in cluster.pods if not owned(p)]
 

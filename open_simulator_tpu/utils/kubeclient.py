@@ -204,6 +204,11 @@ def snapshot_cluster(client: KubeClient):
     )
     objs.extend(client.list("/api/v1/configmaps", "ConfigMap"))
     objs.extend(client.list("/apis/apps/v1/daemonsets", "DaemonSet"))
+    # the reference syncs StatefulSet + ReplicaSet listers too
+    # (server.go:114-116): scale-apps resolves a Deployment's pods through
+    # its owned ReplicaSets, so the snapshot must carry them
+    objs.extend(client.list("/apis/apps/v1/statefulsets", "StatefulSet"))
+    objs.extend(client.list("/apis/apps/v1/replicasets", "ReplicaSet"))
     return ClusterResource.from_objects(objs)
 
 

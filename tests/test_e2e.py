@@ -471,6 +471,50 @@ def test_server_scale_apps_roundtrip():
         with urllib.request.urlopen(req2) as r:
             out2 = json.load(r)
         assert len(out2["unscheduled"]) == 3
+
+        # REAL-cluster shape (no simon annotations): pods owned by a
+        # ReplicaSet, the RS owned by the Deployment — removeWorkloads must
+        # resolve the indirection via the snapshot's RS objects
+        # (removePodsOfApp, server.go:408-419)
+        raw_bound = []
+        for i in range(2):
+            p = json.loads(json.dumps(bound[i]))
+            del p["metadata"]["annotations"]
+            # web-0 carries a leading non-controller ref: OwnedByWorkload
+            # scans ALL ownerReferences, not just the first
+            p["metadata"]["ownerReferences"] = (
+                [{"kind": "Workflow", "name": "nightly"}] if i == 0 else []
+            ) + [{"kind": "ReplicaSet", "name": "web-abc123"}]
+            raw_bound.append(p)
+        rs = {
+            "kind": "ReplicaSet",
+            "apiVersion": "apps/v1",
+            "metadata": {
+                "name": "web-abc123",
+                "namespace": "d",
+                "ownerReferences": [{"kind": "Deployment", "name": "web"}],
+            },
+        }
+        body3 = json.dumps(
+            {
+                "cluster": {"objects": nodes + raw_bound + [rs]},
+                "apps": [{"name": "web", "objects": [scaled]}],
+                "removeWorkloads": [
+                    {"kind": "Deployment", "name": "web", "namespace": "d"}
+                ],
+            }
+        ).encode()
+        req3 = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/scale-apps",
+            data=body3,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req3) as r:
+            out3 = json.load(r)
+        assert out3["unscheduled"] == []
+        assert len(out3["placements"]) == 3
+        assert "d/web-0" not in out3["placements"]
+        assert "d/web-1" not in out3["placements"]
     finally:
         srv.shutdown()
         srv.server_close()

@@ -94,6 +94,22 @@ APIS = {
             }
         ]
     },
+    "/apis/apps/v1/statefulsets": {
+        "items": [{"metadata": {"name": "db", "namespace": "default"}}]
+    },
+    "/apis/apps/v1/replicasets": {
+        "items": [
+            {
+                "metadata": {
+                    "name": "web-abc123",
+                    "namespace": "default",
+                    "ownerReferences": [
+                        {"kind": "Deployment", "name": "web"}
+                    ],
+                },
+            }
+        ]
+    },
 }
 
 
@@ -201,6 +217,14 @@ def test_snapshot_cluster(stub_api):
     assert len(cluster.daemonsets) == 1
     assert "PodDisruptionBudget" in cluster.others
     assert "StorageClass" in cluster.others
+    # the reference also syncs STS/RS listers (server.go:114-116) — the
+    # Deployment->ReplicaSet indirection of scale-apps needs them
+    assert [
+        r["metadata"]["name"] for r in cluster.others.get("ReplicaSet", [])
+    ] == ["web-abc123"]
+    assert [
+        s["metadata"]["name"] for s in cluster.others.get("StatefulSet", [])
+    ] == ["db"]
     # bearer token was sent
     assert "Bearer tok" in _StubAPI.auth_seen
 
