@@ -181,6 +181,32 @@ def test_engine_invariants_randomized():
         _check_invariants(cluster, objs, result)
 
 
+def test_engine_invariants_with_extender(stub_factory):
+    """The per-pod extender path (probe→HTTP→commit, plus extender-aware
+    preemption) must uphold the same physical invariants as the fused batch
+    scan — a pass-through extender routes EVERY pod through it."""
+    from open_simulator_tpu.models.profiles import ExtenderConfig
+
+    stub = stub_factory({})   # keep all nodes, score 0
+    cfg = ExtenderConfig(
+        url_prefix=stub.url, filter_verb="filter",
+        prioritize_verb="prioritize", preempt_verb="preempt",
+    )
+    rng = random.Random(51)
+    for trial in range(4):
+        nodes = _rand_cluster(rng)
+        objs, pdbs = _rand_workloads(rng, rng.randint(1, 3))
+        cluster = ClusterResource(
+            nodes=nodes, others={"PodDisruptionBudget": pdbs}
+        )
+        result = simulate(
+            cluster, [AppResource(name="inv", objects=objs)],
+            extenders=[cfg],
+        )
+        _check_invariants(cluster, objs, result)
+    assert stub.calls   # the extender really was in the path
+
+
 def test_engine_invariants_with_cluster_daemonset():
     rng = random.Random(77)
     for trial in range(4):
