@@ -559,6 +559,14 @@ def test_server_goroutine_dump():
         srv.server_close()
 
 
+def test_server_resync_period_matches_reference():
+    """30 s is the reference's SharedInformerFactory resync period
+    (server.go:106) — the snapshot-cache TTL must track it."""
+    from open_simulator_tpu.server import server as server_mod
+
+    assert server_mod.RESYNC_SECONDS == 30.0
+
+
 def test_server_snapshot_cache(monkeypatch):
     """Kubeconfig/master-backed serving reuses one cluster snapshot across
     requests within the resync TTL (informer-cache parity, server.go:98-136)
