@@ -607,7 +607,11 @@ def _run_headline(pods: int, nodes: int) -> dict:
     run = time.time() - t1
     scheduled = int((placed >= 0).sum())
     pods_per_sec = pods / run
+
+    from open_simulator_tpu.ops.fast import PATH_COUNTS
+
     return {
+        "paths": {k: v for k, v in PATH_COUNTS.items() if v},
         "metric": f"schedule_{_fmt_count(pods)}_pods_{_fmt_count(nodes)}_nodes",
         "value": round(pods_per_sec, 1),
         "unit": "pods/s",
@@ -629,6 +633,7 @@ def _run_headline(pods: int, nodes: int) -> dict:
 # child process (same reasoning as _probe_backend).
 SEGMENT_TIMEOUT_S = {
     "headline": 1200.0,
+    "canary": 300.0,
     "stock": 900.0,
     "fit_1k_100n": 600.0,
     "spread_aff_10k_1k": 900.0,
@@ -649,7 +654,7 @@ def _segment_main(name: str, pods: int, nodes: int) -> int:
     ensure_platform()
     enable_compilation_cache()
     try:
-        if name == "headline":
+        if name in ("headline", "canary"):
             out = _run_headline(pods, nodes)
         else:
             out = CONFIGS[name]()
@@ -746,6 +751,25 @@ def main() -> int:
         result.update(backend_info)
         print(json.dumps(result))
         return 0
+
+    if platform != "cpu" and "fallback" not in backend_info:
+        # Device canary: a miniature headline under a tight deadline. The
+        # round-5 tunnel failure mode is init-succeeds-but-programs-wedge
+        # (backend probe passed in 10 s, then the 100k headline hung its
+        # full 1200 s deadline); a 5-minute canary converts that 20-minute
+        # burn into a fast, labeled CPU fallback — and its pods/s is a real
+        # small-scale device number even when the full headline later fails.
+        canary = _run_segment("canary", 2_000, 200, platform)
+        backend_info["canary"] = canary
+        if "error" in canary:
+            print(
+                f"canary failed on '{platform}' ({canary['error']}); "
+                "falling back to cpu for all segments",
+                file=sys.stderr, flush=True,
+            )
+            backend_info["fallback"] = "cpu"
+            backend_info["fallback_reason"] = f"canary: {canary['error']}"
+            platform = "cpu"
 
     result = _run_segment("headline", args.pods, args.nodes, platform)
     if "error" in result and platform != "cpu":
