@@ -593,17 +593,33 @@ def _run_headline(pods: int, nodes: int) -> dict:
     ns, carry, batch = build_state(nodes, pods)
     t_enc = time.time() - t_enc0
     w = weights_array()
+    # Cap on per-group device-program length (scan steps per dispatch).
+    # Overridable for tunnel experiments: the axon relay wedges on some
+    # large programs, and a smaller chunk bounds what each dispatch asks
+    # of the remote worker (scripts/tpu_bisect.sh sweeps this).
+    try:
+        chunk = int(os.environ.get("OSIM_HEADLINE_CHUNK", "16384"))
+    except ValueError:
+        raise SystemExit(
+            f"OSIM_HEADLINE_CHUNK must be a positive integer, got "
+            f"{os.environ['OSIM_HEADLINE_CHUNK']!r}"
+        )
+    if chunk <= 0:
+        # chunk<=0 would make the fast-path chunking loop spin forever
+        raise SystemExit(
+            f"OSIM_HEADLINE_CHUNK must be a positive integer, got {chunk}"
+        )
 
     # Warm up with one full untimed pass (same shapes => same executables),
     # then one timed pass. The grouped scheduler's per-group chunking
     # (schedule_batch_grouped max_group_chunk) bounds each device program to a
     # few seconds — a single 100k-step scan trips the TPU worker's watchdog.
     t0 = time.time()
-    schedule_batch_fast(ns, carry, batch, w)
+    schedule_batch_fast(ns, carry, batch, w, max_group_chunk=chunk)
     compile_s = time.time() - t0
 
     t1 = time.time()
-    _, placed, *_ = schedule_batch_fast(ns, carry, batch, w)
+    _, placed, *_ = schedule_batch_fast(ns, carry, batch, w, max_group_chunk=chunk)
     run = time.time() - t1
     scheduled = int((placed >= 0).sum())
     pods_per_sec = pods / run
@@ -752,7 +768,11 @@ def main() -> int:
         print(json.dumps(result))
         return 0
 
-    if platform != "cpu" and "fallback" not in backend_info:
+    if (
+        platform != "cpu"
+        and "fallback" not in backend_info
+        and not backend_info.get("backend_probe", "").startswith("cpu")
+    ):
         # Device canary: a miniature headline under a tight deadline. The
         # round-5 tunnel failure mode is init-succeeds-but-programs-wedge
         # (backend probe passed in 10 s, then the 100k headline hung its

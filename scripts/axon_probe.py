@@ -1,0 +1,107 @@
+"""Staged axon-tunnel probes: find where a wedge starts.
+
+The round-5 failure mode is init-succeeds-but-programs-wedge: the tunnel
+initializes and runs a trivial program in seconds, then the first real
+headline program hangs indefinitely (and afterwards even backend init
+hangs until the server side recovers). Each stage here is small, prints
+a JSON line when it completes, and is meant to run under `timeout` in a
+killable child so a hang costs its deadline, not the session:
+
+    timeout 90  python scripts/axon_probe.py matmul
+    timeout 180 python scripts/axon_probe.py transfer
+    timeout 240 python scripts/axon_probe.py scan
+    timeout 300 python scripts/axon_probe.py sort
+
+Run the stages in order; the first one that times out localizes the
+wedge (RPC transfer vs compiled-program dispatch vs the specific op
+family the scheduler leans on). scripts/tpu_bisect.sh drives the full
+ladder including bench headlines at escalating sizes.
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "axon"
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+t0 = time.time()
+devs = jax.devices()
+print(
+    json.dumps(
+        {
+            "stage": "init",
+            "s": round(time.time() - t0, 1),
+            "devices": [str(d) for d in devs],
+        }
+    ),
+    flush=True,
+)
+
+stage = sys.argv[1] if len(sys.argv) > 1 else "matmul"
+
+
+def timed(name, fn):
+    t = time.time()
+    out = fn()
+    if out is not None:
+        jax.block_until_ready(out)
+    print(
+        json.dumps({"stage": name, "s": round(time.time() - t, 2)}),
+        flush=True,
+    )
+
+
+if stage == "matmul":
+    x = jnp.ones((1024, 1024), jnp.bfloat16)
+    f = jax.jit(lambda a: a @ a)
+    timed("matmul_compile+run", lambda: f(x))
+    timed("matmul_warm_x10", lambda: [f(x) for _ in range(10)][-1])
+elif stage == "transfer":
+    import numpy as np
+
+    for mb in (1, 8, 64):
+        n = mb * 1024 * 1024 // 4
+        a = np.ones(n, np.float32)
+        t = time.time()
+        da = jax.device_put(a)
+        da.block_until_ready()
+        up = time.time() - t
+        t = time.time()
+        np.asarray(da)
+        down = time.time() - t
+        print(
+            json.dumps(
+                {
+                    "stage": f"transfer_{mb}MB",
+                    "up_s": round(up, 2),
+                    "down_s": round(down, 2),
+                }
+            ),
+            flush=True,
+        )
+elif stage == "scan":
+    # The scheduler's program shape: a long lax.scan whose carry updates
+    # via indexed adds (dynamic_update_slice family).
+    def body(c, x):
+        return c.at[x % 1000].add(1.0), x
+
+    f = jax.jit(lambda c, xs: jax.lax.scan(body, c, xs))
+    c0 = jnp.zeros(1000, jnp.float32)
+    xs = jnp.arange(16384, dtype=jnp.int32)
+    timed("scan16k_compile+run", lambda: f(c0, xs)[0])
+    timed("scan16k_warm", lambda: f(c0, xs)[0])
+elif stage == "sort":
+    # The sort fast path's program shape: key-sort over the node axis.
+    k = jax.random.key(0)
+    x = jax.random.uniform(k, (100_000,))
+    f = jax.jit(lambda a: jnp.sort(a))
+    timed("sort100k_compile+run", lambda: f(x))
+    timed("sort100k_warm", lambda: f(x))
+else:
+    print(json.dumps({"error": f"unknown stage {stage!r}"}), flush=True)
+    sys.exit(2)
+print(json.dumps({"stage": "done", "ok": True}), flush=True)

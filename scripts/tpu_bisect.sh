@@ -1,0 +1,86 @@
+#!/bin/bash
+# Escalating axon-tunnel bisect: localize the wedge, then capture the round.
+#
+# Round-5 observed failure mode: backend init + a trivial program succeed in
+# seconds, then the first real headline program hangs indefinitely; after a
+# hang, even init hangs until the server side recovers (minutes to hours).
+# This ladder runs ever-larger pieces of the real workload, each in a
+# killable child under a deadline, waiting for the tunnel to re-initialize
+# after any hang — so one pass tells us the largest thing that works and the
+# smallest thing that doesn't, with timestamps, in $OUT.
+#
+# Usage: scripts/tpu_bisect.sh          (full ladder)
+# Results: /tmp/tpu_bisect/NN_<stage>.{out,err}, summary.log
+set -u
+OUT=/tmp/tpu_bisect
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+SUMMARY="$OUT/summary.log"
+
+note() { echo "$(date -u +%H:%M:%S) $*" | tee -a "$SUMMARY"; }
+
+# Wait (up to 40 min) for the tunnel to answer a 90 s matmul probe.
+wait_up() {
+    for _ in $(seq 1 20); do
+        if timeout 90 python scripts/axon_probe.py matmul \
+            > "$OUT/probe.out" 2> "$OUT/probe.err"; then
+            note "tunnel UP: $(tail -2 "$OUT/probe.out" | head -1)"
+            return 0
+        fi
+        note "tunnel down; retry in 120s"
+        sleep 120
+    done
+    return 1
+}
+
+run_stage() { # run_stage NN name deadline cmd...
+    local nn=$1 name=$2 deadline=$3; shift 3
+    note "stage $nn $name (deadline ${deadline}s): $*"
+    if timeout "$deadline" "$@" > "$OUT/${nn}_${name}.out" 2> "$OUT/${nn}_${name}.err"; then
+        note "stage $nn $name OK: $(grep -v WARNING "$OUT/${nn}_${name}.out" | tail -1 | cut -c1-220)"
+        return 0
+    fi
+    note "stage $nn $name FAILED/HUNG (rc=$?)"
+    wait_up || { note "tunnel never recovered; aborting ladder"; exit 1; }
+    return 1
+}
+
+wait_up || { note "tunnel down at start; aborting"; exit 1; }
+
+run_stage 01 transfer 180 python scripts/axon_probe.py transfer
+run_stage 02 scan 240 python scripts/axon_probe.py scan
+run_stage 03 sort 300 python scripts/axon_probe.py sort
+
+# Real headline programs at escalating scale. --quick runs in-process on the
+# tunnel; larger sizes go through the bench's own killable-segment machinery
+# but are invoked here as --segment children directly so each has OUR deadline.
+run_stage 04 quick_2k 420 env JAX_PLATFORMS=axon python bench.py --quick --configs none
+# --quick goes through _select_backend and silently falls back to CPU when the
+# probe fails, still printing pods/s — require an actual TPU device string.
+if ! grep -q '"device": "TPU' "$OUT/04_quick_2k.out" 2>/dev/null; then
+    # cache interaction check: same tiny headline with the persistent
+    # compilation cache disabled
+    run_stage 05 quick_2k_nocache 420 env JAX_PLATFORMS=axon OSIM_COMPILE_CACHE= \
+        python bench.py --quick --configs none
+fi
+
+run_stage 06 mid_10k 600 env JAX_PLATFORMS=axon \
+    python bench.py --segment headline --pods 10000 --nodes 1000
+run_stage 07 mid_20k 600 env JAX_PLATFORMS=axon \
+    python bench.py --segment headline --pods 20000 --nodes 2000
+run_stage 08 mid_50k 900 env JAX_PLATFORMS=axon \
+    python bench.py --segment headline --pods 50000 --nodes 5000
+run_stage 09 full_100k 1200 env JAX_PLATFORMS=axon \
+    python bench.py --segment headline --pods 100000 --nodes 10000
+
+# If the full headline only works with smaller device programs, sweep chunk.
+if ! grep -q pods/s "$OUT/09_full_100k.out" 2>/dev/null; then
+    for c in 4096 1024; do
+        run_stage "10c$c" "full_100k_chunk$c" 1200 env JAX_PLATFORMS=axon \
+            OSIM_HEADLINE_CHUNK=$c \
+            python bench.py --segment headline --pods 100000 --nodes 10000
+        grep -q pods/s "$OUT/10c${c}_full_100k_chunk$c.out" 2>/dev/null && break
+    done
+fi
+
+note "ladder complete; if full_100k passed, run scripts/tpu_round_capture.sh"
