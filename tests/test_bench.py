@@ -1,0 +1,52 @@
+"""Bench harness contract tests: canary segment routing + chunk knob.
+
+The driver runs bench.py unattended at round end; these pin the pieces a
+wedged TPU tunnel leans on — the canary segment must route to the headline
+runner (so its deadline entry is honored) and a bad OSIM_HEADLINE_CHUNK
+must fail fast with a clear message instead of hanging the chunking loop.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import bench
+
+
+def test_canary_segment_routes_to_headline(monkeypatch, capsys):
+    # _segment_main enables the persistent compilation cache; keep this
+    # test from flipping that global on for the rest of the suite
+    monkeypatch.setenv("OSIM_COMPILE_CACHE", "")
+    seen = {}
+
+    def fake_headline(pods, nodes):
+        seen["sizes"] = (pods, nodes)
+        return {"ok": True}
+
+    monkeypatch.setattr(bench, "_run_headline", fake_headline)
+    rc = bench._segment_main("canary", 2_000, 200)
+    assert rc == 0
+    assert seen["sizes"] == (2_000, 200)
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    assert json.loads(out) == {"ok": True}
+
+
+def test_canary_has_tighter_deadline_than_headline():
+    assert bench.SEGMENT_TIMEOUT_S["canary"] < bench.SEGMENT_TIMEOUT_S["headline"]
+
+
+def test_bad_chunk_fails_fast_not_hangs():
+    """chunk<=0 would spin the fast-path chunk loop forever; it must exit
+    immediately with the knob's name in the message (both malformed and
+    non-positive values)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    for bad in ("0", "4k"):
+        env["OSIM_HEADLINE_CHUNK"] = bad
+        r = subprocess.run(
+            [sys.executable, bench.__file__, "--quick", "--configs", "none"],
+            env=env, capture_output=True, text=True, timeout=120,
+        )
+        assert r.returncode != 0
+        assert "OSIM_HEADLINE_CHUNK" in (r.stderr + r.stdout)
