@@ -650,6 +650,7 @@ def _run_headline(pods: int, nodes: int) -> dict:
 SEGMENT_TIMEOUT_S = {
     "headline": 1200.0,
     "canary": 300.0,
+    "headline_mid": 600.0,
     "stock": 900.0,
     "fit_1k_100n": 600.0,
     "spread_aff_10k_1k": 900.0,
@@ -670,7 +671,7 @@ def _segment_main(name: str, pods: int, nodes: int) -> int:
     ensure_platform()
     enable_compilation_cache()
     try:
-        if name in ("headline", "canary"):
+        if name in ("headline", "canary", "headline_mid"):
             out = _run_headline(pods, nodes)
         else:
             out = CONFIGS[name]()
@@ -751,6 +752,17 @@ def main() -> int:
     backend_info = _select_backend()
     platform = os.environ.get("JAX_PLATFORMS", "")
 
+    def _fall_back_to_cpu(stage: str, err: str) -> str:
+        """Label the fallback in backend_info and return the new platform."""
+        print(
+            f"{stage} failed on '{platform or 'default'}' ({err}); "
+            "falling back to cpu for all remaining segments",
+            file=sys.stderr, flush=True,
+        )
+        backend_info["fallback"] = "cpu"
+        backend_info["fallback_reason"] = f"{stage}: {err}" if stage != "headline" else err
+        return "cpu"
+
     # Every segment runs in its own killable subprocess under a deadline, and
     # results flush to stderr as they land: a TPU-tunnel wedge mid-run (it
     # hangs device calls indefinitely; observed repeatedly in-round) costs one
@@ -782,27 +794,28 @@ def main() -> int:
         canary = _run_segment("canary", 2_000, 200, platform)
         backend_info["canary"] = canary
         if "error" in canary:
-            print(
-                f"canary failed on '{platform}' ({canary['error']}); "
-                "falling back to cpu for all segments",
-                file=sys.stderr, flush=True,
-            )
-            backend_info["fallback"] = "cpu"
-            backend_info["fallback_reason"] = f"canary: {canary['error']}"
-            platform = "cpu"
+            platform = _fall_back_to_cpu("canary", canary["error"])
+        elif (
+            "TPU" in str(canary.get("device", "")) and args.pods > 20_000
+        ):
+            # The canary proved the device on small shapes; bank a mid-size
+            # device number BEFORE risking the full headline — if the 100k
+            # program wedges the tunnel (observed round 5), this is the
+            # at-scale TPU evidence that survives in the JSON. Skipped when
+            # the requested headline isn't actually bigger than the mid.
+            mid = _run_segment("headline_mid", 20_000, 2_000, platform)
+            backend_info["headline_mid"] = mid
+            if "error" in mid:
+                # mid-size already wedges: the full headline has no chance
+                # and the tunnel likely needs recovery — go straight to CPU
+                # for the official metric, keeping the canary as evidence.
+                platform = _fall_back_to_cpu("headline_mid", mid["error"])
 
     result = _run_segment("headline", args.pods, args.nodes, platform)
     if "error" in result and platform != "cpu":
         # The TPU died mid-headline: re-measure on CPU so the round still
         # records a real number, clearly labeled.
-        print(
-            f"headline failed on '{platform or 'default'}' "
-            f"({result['error']}); re-running on cpu", file=sys.stderr,
-            flush=True,
-        )
-        backend_info["fallback"] = "cpu"
-        backend_info["fallback_reason"] = result["error"]
-        platform = "cpu"
+        platform = _fall_back_to_cpu("headline", result["error"])
         result = _run_segment("headline", args.pods, args.nodes, platform)
     result.update(backend_info)
     print(f"headline: {json.dumps(result)}", file=sys.stderr, flush=True)

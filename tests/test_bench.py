@@ -36,6 +36,98 @@ def test_canary_has_tighter_deadline_than_headline():
     assert bench.SEGMENT_TIMEOUT_S["canary"] < bench.SEGMENT_TIMEOUT_S["headline"]
 
 
+def _drive_main(monkeypatch, capsys, segment_results, argv=None):
+    """Run bench.main() with canned per-segment results; return (calls, out).
+
+    segment_results: {segment_name: dict} — what _run_segment returns.
+    Each recorded call is (name, pods, nodes, platform).
+    """
+    calls = []
+
+    def fake_run_segment(name, pods, nodes, platform):
+        calls.append((name, pods, nodes, platform))
+        return dict(segment_results[name])
+
+    monkeypatch.setattr(bench, "_run_segment", fake_run_segment)
+    monkeypatch.setattr(
+        bench, "_select_backend",
+        lambda *a, **k: {"requested_platform": "axon", "backend_probe": "tpu 1"},
+    )
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.setattr(
+        sys, "argv", argv or ["bench.py", "--configs", "none"]
+    )
+    rc = bench.main()
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    return calls, out
+
+
+def test_mid_headline_banks_tpu_number_before_full(monkeypatch, capsys):
+    """A TPU-passing canary inserts the 20k mid headline before the 100k."""
+    calls, out = _drive_main(
+        monkeypatch, capsys,
+        {
+            "canary": {"value": 1.0, "device": "TPU v5 lite0"},
+            "headline_mid": {"value": 2.0, "device": "TPU v5 lite0"},
+            "headline": {"value": 3.0, "device": "TPU v5 lite0"},
+        },
+    )
+    assert [c[0] for c in calls] == ["canary", "headline_mid", "headline"]
+    assert calls[1][1:] == (20_000, 2_000, "axon")
+    assert calls[2][3] == "axon"  # full headline stayed on the device
+    assert out["headline_mid"]["value"] == 2.0
+    assert "fallback" not in out
+
+
+def test_mid_headline_wedge_flips_full_to_cpu(monkeypatch, capsys):
+    """If the mid headline wedges, the full headline runs on CPU and the
+    canary evidence survives in the output."""
+    calls, out = _drive_main(
+        monkeypatch, capsys,
+        {
+            "canary": {"value": 1.0, "device": "TPU v5 lite0"},
+            "headline_mid": {"error": "timeout after 600s (device hang?)"},
+            "headline": {"value": 3.0, "device": "TFRT_CPU_0"},
+        },
+    )
+    assert [c[0] for c in calls] == ["canary", "headline_mid", "headline"]
+    assert calls[2][3] == "cpu"
+    assert out["fallback"] == "cpu"
+    assert "headline_mid" in out["fallback_reason"]
+    assert out["canary"]["device"] == "TPU v5 lite0"
+
+
+def test_mid_skipped_when_headline_not_bigger(monkeypatch, capsys):
+    """--pods at or below the mid size must not run an oversized mid stage
+    (whose failure would wrongly force CPU for a feasible small headline)."""
+    calls, out = _drive_main(
+        monkeypatch, capsys,
+        {
+            "canary": {"value": 1.0, "device": "TPU v5 lite0"},
+            "headline": {"value": 3.0, "device": "TPU v5 lite0"},
+        },
+        argv=["bench.py", "--configs", "none", "--pods", "5000",
+              "--nodes", "500"],
+    )
+    assert [c[0] for c in calls] == ["canary", "headline"]
+    assert "fallback" not in out
+
+
+def test_canary_wedge_skips_mid_and_flips_to_cpu(monkeypatch, capsys):
+    calls, out = _drive_main(
+        monkeypatch, capsys,
+        {
+            "canary": {"error": "timeout after 300s (device hang?)"},
+            "headline": {"value": 3.0, "device": "TFRT_CPU_0"},
+        },
+    )
+    assert [c[0] for c in calls] == ["canary", "headline"]
+    assert calls[1][3] == "cpu"
+    assert out["fallback"] == "cpu"
+    assert "canary" in out["fallback_reason"]
+
+
 def test_bad_chunk_fails_fast_not_hangs():
     """chunk<=0 would spin the fast-path chunk loop forever; it must exit
     immediately with the knob's name in the message (both malformed and
