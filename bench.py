@@ -626,7 +626,7 @@ def _run_headline(pods: int, nodes: int) -> dict:
 
     from open_simulator_tpu.ops.fast import PATH_COUNTS
 
-    return {
+    out = {
         "paths": {k: v for k, v in PATH_COUNTS.items() if v},
         "metric": f"schedule_{_fmt_count(pods)}_pods_{_fmt_count(nodes)}_nodes",
         "value": round(pods_per_sec, 1),
@@ -640,6 +640,11 @@ def _run_headline(pods: int, nodes: int) -> dict:
         "nodes": nodes,
         "device": str(jax.devices()[0]),
     }
+    if chunk != 16384:
+        # a non-default dispatch granularity changes what the number means —
+        # stamp it so the JSON is never mistaken for a default-chunk figure
+        out["group_chunk"] = chunk
+    return out
 
 
 # Per-segment wall-clock deadlines (seconds). Generous vs expected runtimes

@@ -74,13 +74,28 @@ run_stage 09 full_100k 1200 env JAX_PLATFORMS=axon \
     python bench.py --segment headline --pods 100000 --nodes 10000
 
 # If the full headline only works with smaller device programs, sweep chunk.
+PASS_CHUNK=
 if ! grep -q pods/s "$OUT/09_full_100k.out" 2>/dev/null; then
     for c in 4096 1024; do
         run_stage "10c$c" "full_100k_chunk$c" 1200 env JAX_PLATFORMS=axon \
             OSIM_HEADLINE_CHUNK=$c \
             python bench.py --segment headline --pods 100000 --nodes 10000
-        grep -q pods/s "$OUT/10c${c}_full_100k_chunk$c.out" 2>/dev/null && break
+        if grep -q pods/s "$OUT/10c${c}_full_100k_chunk$c.out" 2>/dev/null; then
+            PASS_CHUNK=$c
+            break
+        fi
     done
 fi
 
-note "ladder complete; if full_100k passed, run scripts/tpu_round_capture.sh"
+if grep -q pods/s "$OUT"/09_full_100k.out "$OUT"/10c*_full_100k_chunk*.out 2>/dev/null; then
+    # Propagate what the ladder just learned: the device platform, and — if
+    # the default-chunk headline hung and only a chunk-sweep size passed —
+    # that chunk, so the capture doesn't re-run the known-wedging shape.
+    export JAX_PLATFORMS=axon
+    [ -n "$PASS_CHUNK" ] && export OSIM_HEADLINE_CHUNK=$PASS_CHUNK
+    note "full headline passed — chaining into the round capture" \
+        "(chunk=${OSIM_HEADLINE_CHUNK:-default})"
+    bash scripts/tpu_round_capture.sh 2>&1 | tee -a "$SUMMARY"
+else
+    note "ladder complete; full headline did not pass — see $OUT for the bracket"
+fi

@@ -17,12 +17,15 @@ timeout 7200 python bench.py 2>"$OUT/bench.err" | tail -1 > "$OUT/bench_tpu.json
 tail -c 400 "$OUT/bench_tpu.json"; echo
 
 echo "== 2/3 Pallas parity (compiled, real TPU) =="
-OSIM_PALLAS=1 timeout 1800 python -m pytest tests/test_fast.py -q -k domain \
+# OSIM_TEST_PLATFORM=axon: conftest.py otherwise pins tests to CPU, which
+# would make this stage silently validate nothing on-device.
+OSIM_TEST_PLATFORM=axon OSIM_PALLAS=1 timeout 1800 \
+    python -m pytest tests/test_fast.py -q -k domain \
     > "$OUT/pallas_parity.txt" 2>&1
 tail -2 "$OUT/pallas_parity.txt"
 
 echo "== 3/3 Pallas timing A/B =="
-timeout 1800 python - <<'EOF' > "$OUT/pallas_timing.txt" 2>&1
+JAX_PLATFORMS=axon timeout 1800 python - <<'EOF' > "$OUT/pallas_timing.txt" 2>&1
 import os, time
 import numpy as np
 
