@@ -586,19 +586,32 @@ def _run_headline(pods: int, nodes: int) -> dict:
     """The headline kernel benchmark, in-process (called in a child)."""
     import jax
 
-    from open_simulator_tpu.ops.fast import schedule_batch_fast
+    from open_simulator_tpu.ops.fast import (
+        DEFAULT_GROUP_CHUNK,
+        schedule_batch_fast,
+    )
     from open_simulator_tpu.ops.kernels import weights_array
+
+    def phase(msg: str) -> None:
+        # Stderr breadcrumbs: when a tunnel deadline kills this child, the
+        # .err file's last line says which phase hung (encode vs compile
+        # pass vs timed pass) — see BASELINE.md round-5 wedge forensics.
+        print(f"[headline {time.strftime('%H:%M:%S')}] {msg}",
+              file=sys.stderr, flush=True)
 
     t_enc0 = time.time()
     ns, carry, batch = build_state(nodes, pods)
     t_enc = time.time() - t_enc0
+    phase(f"encode done in {t_enc:.1f}s (pods={pods} nodes={nodes})")
     w = weights_array()
     # Cap on per-group device-program length (scan steps per dispatch).
     # Overridable for tunnel experiments: the axon relay wedges on some
     # large programs, and a smaller chunk bounds what each dispatch asks
     # of the remote worker (scripts/tpu_bisect.sh sweeps this).
     try:
-        chunk = int(os.environ.get("OSIM_HEADLINE_CHUNK", "16384"))
+        chunk = int(
+            os.environ.get("OSIM_HEADLINE_CHUNK", str(DEFAULT_GROUP_CHUNK))
+        )
     except ValueError:
         raise SystemExit(
             f"OSIM_HEADLINE_CHUNK must be a positive integer, got "
@@ -614,13 +627,16 @@ def _run_headline(pods: int, nodes: int) -> dict:
     # then one timed pass. The grouped scheduler's per-group chunking
     # (schedule_batch_grouped max_group_chunk) bounds each device program to a
     # few seconds — a single 100k-step scan trips the TPU worker's watchdog.
+    phase("warm pass (compiles) starting")
     t0 = time.time()
     schedule_batch_fast(ns, carry, batch, w, max_group_chunk=chunk)
     compile_s = time.time() - t0
+    phase(f"warm pass done in {compile_s:.1f}s; timed pass starting")
 
     t1 = time.time()
     _, placed, *_ = schedule_batch_fast(ns, carry, batch, w, max_group_chunk=chunk)
     run = time.time() - t1
+    phase(f"timed pass done in {run:.2f}s")
     scheduled = int((placed >= 0).sum())
     pods_per_sec = pods / run
 
@@ -640,7 +656,7 @@ def _run_headline(pods: int, nodes: int) -> dict:
         "nodes": nodes,
         "device": str(jax.devices()[0]),
     }
-    if chunk != 16384:
+    if chunk != DEFAULT_GROUP_CHUNK:
         # a non-default dispatch granularity changes what the number means —
         # stamp it so the JSON is never mistaken for a default-chunk figure
         out["group_chunk"] = chunk

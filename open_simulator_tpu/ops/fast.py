@@ -36,6 +36,8 @@ from __future__ import annotations
 
 import functools
 import os
+import sys
+import time
 from typing import NamedTuple, Tuple
 
 import jax
@@ -43,7 +45,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from .encode import PodBatch
-from .grouped import _bucket, _group_call, _static_parts, group_runs
+from .grouped import (
+    DEFAULT_GROUP_CHUNK,
+    _bucket,
+    _group_call,
+    _static_parts,
+    group_runs,
+)
 from .kernels import (
     Carry,
     F_GPU,
@@ -87,6 +95,18 @@ PATH_COUNTS = {
 # groups whose nodes span more classes take the micro scan instead. Tests may
 # set this to 0 to force the micro body.
 DM_CAP = 64
+
+# Wedge forensics (OSIM_PROGRESS=1): one stderr line immediately BEFORE each
+# device dispatch, so when a tunnel deadline kills the process the last line
+# names the exact group/path/shape that hung — the axon relay's failure mode
+# is a silent indefinite block inside one device call (BASELINE.md round-5).
+_PROGRESS = os.environ.get("OSIM_PROGRESS", "") not in ("", "0")
+
+
+def _progress(msg: str) -> None:
+    if _PROGRESS:
+        print(f"[osim {time.strftime('%H:%M:%S')}] {msg}",
+              file=sys.stderr, flush=True)
 
 
 # Channel layout of Trajectory.packed — everything the selection step needs,
@@ -1474,7 +1494,7 @@ def schedule_batch_fast(
     carry: Carry,
     batch: PodBatch,
     weights,
-    max_group_chunk: int = 16384,
+    max_group_chunk: int = DEFAULT_GROUP_CHUNK,
     force_fast: bool = False,
     filter_on=None,
     extra_filters=(),
@@ -1551,6 +1571,7 @@ def schedule_batch_fast(
             while done < length:
                 n = min(length - done, max_group_chunk)
                 g = _bucket(n)
+                _progress(f"group@{start} len={length} grouped chunk g={g}")
                 carry, (nodes, reasons, take, vg_take, dev_take) = _group_call(
                     ns, carry, row, g, jnp.int32(n), weights, filter_on,
                     extra_filters, extra_scores,
@@ -1565,6 +1586,7 @@ def schedule_batch_fast(
             continue
 
         j_steps = _bucket_j(j_need)
+        _progress(f"group@{start} len={length} traj j={j_steps} N={N}")
         traj, static_ok, static_ff, static_scores, na_ok = build_trajectory(
             ns, carry, row, weights, j_steps, filter_on
         )
@@ -1584,6 +1606,7 @@ def schedule_batch_fast(
                 na_ok, weights, x_dev, cur_at(traj, x_dev), filter_on, flags,
             )
             carry_dev = exit_carry(ns, carry, row, traj, x_dev)
+            _progress(f"group@{start} finish sync")
             mono_np, *got = jax.device_get(
                 (jnp.bool_(True) if mono_dev is None else mono_dev,
                  nodes_dev, take_dev, vg_dev, dev_dev, reason_dev)
@@ -1610,6 +1633,7 @@ def schedule_batch_fast(
         # check's verdict is fetched together with the speculated tail).
         out_size = _bucket_light(length)
         if _sortable(flags) and out_size <= N * j_steps:
+            _progress(f"group@{start} sort out={out_size}")
             mono, nodes_d, jidx_d, x = sort_select(
                 ns, traj, row, static_ok, static_scores, weights,
                 jnp.int32(length), out_size, filter_on,
@@ -1639,6 +1663,7 @@ def schedule_batch_fast(
             if plan is not None:
                 g = _bucket_light(length)
                 l_cap = _bucket_light(min(int(plan.counts.max()), length))
+                _progress(f"group@{start} domain g={g} l_cap={l_cap}")
                 # the Pallas kernel implements the spread-only step body
                 use_pallas = _pallas_requested() and not (
                     flags.any_req_aff or flags.any_pref_aff
@@ -1673,6 +1698,7 @@ def schedule_batch_fast(
             while done < length:
                 n = min(length - done, max_group_chunk)
                 g = _bucket_light(n)
+                _progress(f"group@{start} light-scan chunk g={g} done={done}")
                 x, nodes, jidxs = light_scan(
                     ns, traj, carry, row, static_ok, static_scores,
                     na_ok, weights, x, jnp.int32(done), g,

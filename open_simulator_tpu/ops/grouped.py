@@ -66,6 +66,12 @@ from .kernels import (
 )
 from .state import pod_rows_from_batch
 
+# Default cap on per-group device-program length (scan steps per dispatch) —
+# one 100k-step scan trips the TPU worker's watchdog, so dispatches are
+# bounded. Shared by schedule_batch_grouped, schedule_batch_fast and
+# bench.py's OSIM_HEADLINE_CHUNK default/stamp so the sites cannot drift.
+DEFAULT_GROUP_CHUNK = 16384
+
 
 def _static_parts(ns: NodeStatic, pod: PodRow, weights: jnp.ndarray, filter_on=None):
     """Masks/scores that do not depend on the scan carry. `filter_on`
@@ -315,7 +321,7 @@ def schedule_batch_grouped(
     carry: Carry,
     batch: PodBatch,
     weights,
-    max_group_chunk: int = 16384,
+    max_group_chunk: int = DEFAULT_GROUP_CHUNK,
     filter_on=None,
     extra_filters=(),
     extra_scores=(),

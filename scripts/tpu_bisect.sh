@@ -16,22 +16,7 @@ OUT=/tmp/tpu_bisect
 mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
 SUMMARY="$OUT/summary.log"
-
-note() { echo "$(date -u +%H:%M:%S) $*" | tee -a "$SUMMARY"; }
-
-# Wait (up to 40 min) for the tunnel to answer a 90 s matmul probe.
-wait_up() {
-    for _ in $(seq 1 20); do
-        if timeout 90 python scripts/axon_probe.py matmul \
-            > "$OUT/probe.out" 2> "$OUT/probe.err"; then
-            note "tunnel UP: $(tail -2 "$OUT/probe.out" | head -1)"
-            return 0
-        fi
-        note "tunnel down; retry in 120s"
-        sleep 120
-    done
-    return 1
-}
+. scripts/tpu_lib.sh
 
 run_stage() { # run_stage NN name deadline cmd...
     local nn=$1 name=$2 deadline=$3; shift 3
@@ -87,15 +72,8 @@ if ! grep -q pods/s "$OUT/09_full_100k.out" 2>/dev/null; then
     done
 fi
 
-if grep -q pods/s "$OUT"/09_full_100k.out "$OUT"/10c*_full_100k_chunk*.out 2>/dev/null; then
-    # Propagate what the ladder just learned: the device platform, and — if
-    # the default-chunk headline hung and only a chunk-sweep size passed —
-    # that chunk, so the capture doesn't re-run the known-wedging shape.
-    export JAX_PLATFORMS=axon
-    [ -n "$PASS_CHUNK" ] && export OSIM_HEADLINE_CHUNK=$PASS_CHUNK
-    note "full headline passed — chaining into the round capture" \
-        "(chunk=${OSIM_HEADLINE_CHUNK:-default})"
-    bash scripts/tpu_round_capture.sh 2>&1 | tee -a "$SUMMARY"
-else
-    note "ladder complete; full headline did not pass — see $OUT for the bracket"
-fi
+# Propagate what the ladder just learned: if the default-chunk headline hung
+# and only a chunk-sweep size passed, the capture must not re-run the
+# known-wedging shape — chain_capture_if_passed pins that chunk.
+chain_capture_if_passed "$PASS_CHUNK" \
+    "$OUT"/09_full_100k.out "$OUT"/10c*_full_100k_chunk*.out

@@ -1,0 +1,70 @@
+#!/bin/bash
+# Cache-aware escalating headline ladder (round-5, second iteration).
+#
+# What the first ladder learned (see /tmp/tpu_bisect and BASELINE.md):
+#   - probes + 2k canary PASS on-device (1,698.7 pods/s steady, 74 s remote
+#     compile); 10k x 1k hung a 600 s deadline with no output.
+#   - host<->device transfer through the relay is ~1-8 MB/s and the remote
+#     compile path is slow — a "wedge" may simply be a compile/transfer that
+#     outlives the deadline.
+# This ladder therefore (a) prints per-dispatch breadcrumbs (OSIM_PROGRESS=1
+# + bench phase lines land in each rung's .err), (b) gives first attempts
+# LONG deadlines, and (c) retries each failed rung once after a re-probe —
+# if the persistent compile cache holds axon executables, the retry resumes
+# where the kill landed instead of starting over.
+#
+# Usage: scripts/tpu_ladder2.sh    Results: /tmp/tpu_ladder2/, summary.log
+set -u
+OUT=/tmp/tpu_ladder2
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+SUMMARY="$OUT/summary.log"
+. scripts/tpu_lib.sh
+export OSIM_PROGRESS=1
+
+run_rung() { # run_rung name deadline pods nodes [extra_env...]
+    local name=$1 deadline=$2 pods=$3 nodes=$4; shift 4
+    note "rung $name (deadline ${deadline}s) pods=$pods nodes=$nodes $*"
+    if timeout "$deadline" env JAX_PLATFORMS=axon "$@" \
+        python bench.py --segment headline --pods "$pods" --nodes "$nodes" \
+        > "$OUT/${name}.out" 2> "$OUT/${name}.err"; then
+        note "rung $name OK: $(tail -1 "$OUT/${name}.out" | cut -c1-200)"
+        return 0
+    fi
+    note "rung $name FAILED/HUNG; last breadcrumb: $(grep -v WARNING "$OUT/${name}.err" | tail -1 | cut -c1-160)"
+    return 1
+}
+
+# Try a rung, and on failure wait for the tunnel and retry once (the retry
+# resumes from the persistent compile cache if axon executables serialize).
+rung_with_retry() { # name deadline1 deadline2 pods nodes
+    local name=$1 d1=$2 d2=$3 pods=$4 nodes=$5
+    run_rung "$name" "$d1" "$pods" "$nodes" && return 0
+    wait_up 45 || { note "tunnel never recovered; stopping ladder"; exit 1; }
+    run_rung "${name}_retry" "$d2" "$pods" "$nodes" && return 0
+    # a failed retry usually leaves the tunnel wedged (the documented axon
+    # failure mode) — re-probe now so the NEXT rung's long first deadline
+    # is never burned against a dead tunnel
+    wait_up 45 || { note "tunnel never recovered; stopping ladder"; exit 1; }
+    return 1
+}
+
+wait_up 45 || { note "tunnel down at start"; exit 1; }
+
+# Cache-resume sanity check: the 2k family compiled (74 s) earlier this
+# round. If this re-run's compile_s is seconds, axon executables persist
+# across processes and the retry strategy below is load-bearing. A wedge
+# here takes the tunnel down for whatever follows — re-probe before moving
+# on so r04k's long first attempt isn't burned against a dead tunnel.
+run_rung cache_check_2k 420 2000 200 \
+    || wait_up 45 \
+    || { note "tunnel never recovered after cache check"; exit 1; }
+grep -o '"compile_s": [0-9.]*' "$OUT/cache_check_2k.out" 2>/dev/null | tee -a "$SUMMARY" || true
+
+rung_with_retry r04k 900 600 4000 400 || true
+rung_with_retry r10k 1800 900 10000 1000 || true
+rung_with_retry r20k 1800 900 20000 2000 || true
+rung_with_retry r50k 2400 1200 50000 5000 || true
+rung_with_retry r100k 2400 1200 100000 10000
+
+chain_capture_if_passed "" "$OUT/r100k.out" "$OUT/r100k_retry.out"

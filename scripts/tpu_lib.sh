@@ -1,0 +1,34 @@
+# Shared helpers for the TPU tunnel ladder scripts (sourced, not executed).
+# Callers must set $OUT (scratch dir) and $SUMMARY (log file) first.
+
+note() { echo "$(date -u +%H:%M:%S) $*" | tee -a "$SUMMARY"; }
+
+# Wait for the tunnel to answer a 90 s matmul probe, retrying every 120 s.
+wait_up() { # wait_up [attempts=20]
+    local attempts=${1:-20}
+    for _ in $(seq 1 "$attempts"); do
+        if timeout 90 python scripts/axon_probe.py matmul \
+            > "$OUT/probe.out" 2> "$OUT/probe.err"; then
+            note "tunnel UP: $(tail -2 "$OUT/probe.out" | head -1)"
+            return 0
+        fi
+        note "tunnel down; retry in 120s"
+        sleep 120
+    done
+    return 1
+}
+
+# If any of the given .out files carries a pods/s figure, chain into the
+# full round capture with the platform (and optional chunk) pinned.
+chain_capture_if_passed() { # chain_capture_if_passed chunk file...
+    local chunk=$1; shift
+    if grep -q pods/s "$@" 2>/dev/null; then
+        export JAX_PLATFORMS=axon
+        [ -n "$chunk" ] && export OSIM_HEADLINE_CHUNK="$chunk"
+        note "full headline passed — chaining into the round capture" \
+            "(chunk=${OSIM_HEADLINE_CHUNK:-default})"
+        bash scripts/tpu_round_capture.sh 2>&1 | tee -a "$SUMMARY"
+    else
+        note "ladder done; full headline did not pass — bracket is in $OUT"
+    fi
+}
