@@ -20,6 +20,7 @@ wait_up() { # wait_up [attempts=20]
 
 # If any of the given .out files carries a pods/s figure, chain into the
 # full round capture with the platform (and optional chunk) pinned.
+# Returns 1 when nothing passed so callers can branch to a fallback.
 chain_capture_if_passed() { # chain_capture_if_passed chunk file...
     local chunk=$1; shift
     if grep -q pods/s "$@" 2>/dev/null; then
@@ -30,5 +31,6 @@ chain_capture_if_passed() { # chain_capture_if_passed chunk file...
         bash scripts/tpu_round_capture.sh 2>&1 | tee -a "$SUMMARY"
     else
         note "ladder done; full headline did not pass — bracket is in $OUT"
+        return 1
     fi
 }
