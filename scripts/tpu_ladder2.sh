@@ -7,11 +7,13 @@
 #   - host<->device transfer through the relay is ~1-8 MB/s and the remote
 #     compile path is slow — a "wedge" may simply be a compile/transfer that
 #     outlives the deadline.
-# This ladder therefore (a) prints per-dispatch breadcrumbs (OSIM_PROGRESS=1
-# + bench phase lines land in each rung's .err), (b) gives first attempts
-# LONG deadlines, and (c) retries each failed rung once after a re-probe —
-# if the persistent compile cache holds axon executables, the retry resumes
-# where the kill landed instead of starting over.
+# Strategy: per-dispatch breadcrumbs (OSIM_PROGRESS=1 + bench phase lines in
+# each rung's .err) localize any hang; every failed attempt gets one retry
+# after a re-probe, resuming from the persistent compile cache (axon
+# executables serialize — verified 03:16-03:21, 269 entries banked by the
+# canary); the 100k prize runs FIRST and chains straight into the round
+# capture while the tunnel window is still fresh, with the mid rungs filled
+# in afterwards as evidence points.
 #
 # Usage: scripts/tpu_ladder2.sh    Results: /tmp/tpu_ladder2/, summary.log
 set -u
@@ -44,16 +46,15 @@ run_seg() { # run_seg name deadline segment [pods nodes]
 }
 
 # Try a headline rung, and on failure wait for the tunnel and retry once
-# (the retry resumes from the persistent compile cache, which holds axon
-# executables — verified 03:16-03:21: 269 entries banked by the canary).
+# (the retry resumes from the persistent compile cache).
 rung_with_retry() { # name deadline1 deadline2 pods nodes
     local name=$1 d1=$2 d2=$3 pods=$4 nodes=$5
     run_seg "$name" "$d1" headline "$pods" "$nodes" && return 0
     wait_up 45 || { note "tunnel never recovered; stopping ladder"; exit 1; }
     run_seg "${name}_retry" "$d2" headline "$pods" "$nodes" && return 0
     # a failed retry usually leaves the tunnel wedged (the documented axon
-    # failure mode) — re-probe now so the NEXT rung's long first deadline
-    # is never burned against a dead tunnel
+    # failure mode) — re-probe now so the NEXT attempt's deadline is never
+    # burned against a dead tunnel
     wait_up 45 || { note "tunnel never recovered; stopping ladder"; exit 1; }
     return 1
 }
@@ -62,22 +63,42 @@ wait_up 45 || { note "tunnel down at start"; exit 1; }
 
 # Cache-resume sanity check: the 2k family compiled (74 s) earlier this
 # round. If this re-run's compile_s is seconds, axon executables persist
-# across processes and the retry strategy below is load-bearing. A wedge
-# here takes the tunnel down for whatever follows — re-probe before moving
-# on so r04k's long first attempt isn't burned against a dead tunnel.
+# across processes and the retry strategy is load-bearing. A wedge here
+# takes the tunnel down for whatever follows — re-probe before moving on
+# so the 100k rung's long first attempt isn't burned against a dead tunnel.
 run_seg cache_check_2k 420 headline 2000 200 \
     || wait_up 45 \
     || { note "tunnel never recovered after cache check"; exit 1; }
 grep -o '"compile_s": [0-9.]*' "$OUT/cache_check_2k.out" 2>/dev/null | tee -a "$SUMMARY" || true
 
-rung_with_retry r04k 900 600 4000 400 || true
-rung_with_retry r10k 1800 900 10000 1000 || true
-rung_with_retry r20k 1800 900 20000 2000 || true
-rung_with_retry r50k 2400 1200 50000 5000 || true
-rung_with_retry r100k 2400 1200 100000 10000
+# Prize first: headline families at different scales share no compiled
+# programs (node buckets differ — 2k→N=256, 10k→N=1024, 100k→N=12288), so
+# small rungs only spend window time without shrinking the 100k compile
+# bill. Windows have been short (15-50 min); go for the 100k number while
+# the tunnel is freshest. CPU compile for the whole 100k family is 37 s
+# (~12 programs); at the observed ~5x remote-compile multiplier that's
+# ~3 min — 2400 s is ample headroom for transfer stalls on top.
+rung_with_retry r100k 2400 1200 100000 10000 || true
 
-if ! chain_capture_if_passed "" "$OUT/r100k.out" "$OUT/r100k_retry.out"; then
-    # The full headline never passed this window — bank per-config device
+# Chain into the full round capture IMMEDIATELY after a 100k pass — the
+# capture re-runs the (now cached) headline plus all configs, and must not
+# wait behind the mid rungs lest the window close first.
+if chain_capture_if_passed "" "$OUT/r100k.out" "$OUT/r100k_retry.out"; then
+    captured=1
+else
+    captured=0
+fi
+
+# Mid rungs as evidence points. r10k keeps its long first deadline: its
+# cold family previously hung a 600 s deadline, and nothing the 100k rung
+# compiled warms it (disjoint node buckets).
+rung_with_retry r10k 1800 900 10000 1000 || true
+rung_with_retry r20k 1200 900 20000 2000 || true
+rung_with_retry r50k 1800 1200 50000 5000 || true
+rung_with_retry r04k 600 600 4000 400 || true
+
+if [ "$captured" = 0 ]; then
+    # The full capture never ran this window — bank per-config device
     # numbers instead, so the round still gets on-device evidence for the
     # other six BASELINE configs (each compiles its own program family into
     # the persistent cache, shrinking any later capture's compile bill).
