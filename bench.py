@@ -599,8 +599,11 @@ def _run_headline(pods: int, nodes: int) -> dict:
         print(f"[headline {time.strftime('%H:%M:%S')}] {msg}",
               file=sys.stderr, flush=True)
 
+    from open_simulator_tpu.utils.tracing import span
+
     t_enc0 = time.time()
-    ns, carry, batch = build_state(nodes, pods)
+    with span("encode", pods=pods, nodes=nodes):
+        ns, carry, batch = build_state(nodes, pods)
     t_enc = time.time() - t_enc0
     phase(f"encode done in {t_enc:.1f}s (pods={pods} nodes={nodes})")
     w = weights_array()
@@ -629,12 +632,16 @@ def _run_headline(pods: int, nodes: int) -> dict:
     # few seconds — a single 100k-step scan trips the TPU worker's watchdog.
     phase("warm pass (compiles) starting")
     t0 = time.time()
-    schedule_batch_fast(ns, carry, batch, w, max_group_chunk=chunk)
+    with span("schedule-warm", pods=pods):
+        schedule_batch_fast(ns, carry, batch, w, max_group_chunk=chunk)
     compile_s = time.time() - t0
     phase(f"warm pass done in {compile_s:.1f}s; timed pass starting")
 
     t1 = time.time()
-    _, placed, *_ = schedule_batch_fast(ns, carry, batch, w, max_group_chunk=chunk)
+    with span("schedule-timed", pods=pods):
+        _, placed, *_ = schedule_batch_fast(
+            ns, carry, batch, w, max_group_chunk=chunk
+        )
     run = time.time() - t1
     phase(f"timed pass done in {run:.2f}s")
     scheduled = int((placed >= 0).sum())
@@ -698,6 +705,13 @@ def _segment_main(name: str, pods: int, nodes: int) -> int:
             out = CONFIGS[name]()
     except Exception as e:  # noqa: BLE001 - report, don't crash the parent
         out = {"error": f"{type(e).__name__}: {e}"}
+    if isinstance(out, dict) and "metrics" not in out:
+        # phase histograms / compile-cache behavior / failure reasons for
+        # this segment's process (each segment is its own child, so the
+        # snapshot is per-segment)
+        from open_simulator_tpu.utils.metrics import REGISTRY
+
+        out["metrics"] = REGISTRY.snapshot()
     print(json.dumps(out), flush=True)
     return 0
 
@@ -798,6 +812,9 @@ def main() -> int:
         enable_compilation_cache()
         result = _run_headline(args.pods, args.nodes)
         result.update(backend_info)
+        from open_simulator_tpu.utils.metrics import REGISTRY
+
+        result["metrics"] = REGISTRY.snapshot()
         print(json.dumps(result))
         return 0
 

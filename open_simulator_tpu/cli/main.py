@@ -52,6 +52,11 @@ def _add_apply(sub: argparse._SubParsersAction) -> None:
         help="shard the node axis across this many JAX devices "
         "(0 = all visible devices; 1 = single-device, the default)",
     )
+    p.add_argument(
+        "--metrics-file", default="",
+        help="after the run, write the scheduler metrics snapshot "
+        "(counters/histograms, see docs/observability.md) as JSON here",
+    )
 
 
 def main(argv=None) -> int:
@@ -135,6 +140,13 @@ def main(argv=None) -> int:
             finally:
                 if out is not None:
                     out.close()
+            if args.metrics_file:
+                import json
+
+                from ..utils.metrics import REGISTRY
+
+                with open(args.metrics_file, "w") as fh:
+                    json.dump(REGISTRY.snapshot(), fh, indent=2)
         except (ApplyError, ValueError, OSError) as e:
             print(f"error: {e}", file=sys.stderr)
             return 1

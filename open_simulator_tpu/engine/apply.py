@@ -17,6 +17,7 @@ from typing import List, Optional, TextIO
 
 from ..api.config import SimonConfig
 from ..core.objects import Node
+from ..utils import metrics
 from ..utils.yamlio import (
     json_files_by_stem,
     load_yaml_documents,
@@ -260,11 +261,18 @@ def run_apply(
                 )
                 result = plan.result
 
-    report = full_report(result, extended_resources=extended_resources)
+    with span("render-report"):
+        report = full_report(result, extended_resources=extended_resources)
     if failed_apps:
         report += "\n" + "\n".join(
             f"FAILED APP {fa.name}: {fa.error}" for fa in failed_apps
         )
+    outcome = "ok"
+    if result.unscheduled:
+        outcome = "unschedulable"
+    elif failed_apps:
+        outcome = "render_failed"
+    metrics.APPLY_RUNS.inc(outcome=outcome)
     # color only live terminal output (pterm/DisablePTerm parity): the
     # returned ApplyOutcome.report and --output-file stay plain text
     display = report

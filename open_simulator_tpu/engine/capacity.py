@@ -19,6 +19,8 @@ from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
 
 from ..core.objects import LABEL_NEW_NODE, Node
+from ..utils import metrics
+from ..utils.tracing import span
 from .simulator import AppResource, ClusterResource, SimulateResult, simulate
 
 def new_fake_nodes(template: Node, count: int) -> List[Node]:
@@ -104,11 +106,13 @@ def _probe(
         daemonsets=list(cluster.daemonsets),
         others=dict(cluster.others),
     )
-    return simulate(
-        trial, apps, weights=weights, use_greed=use_greed, mesh=mesh,
-        n_pad=n_pad, profiles=profiles, expand_cache=expand_cache,
-        extenders=extenders,
-    )
+    metrics.CAPACITY_PROBES.inc()
+    with span("capacity-probe", nodes_added=k):
+        return simulate(
+            trial, apps, weights=weights, use_greed=use_greed, mesh=mesh,
+            n_pad=n_pad, profiles=profiles, expand_cache=expand_cache,
+            extenders=extenders,
+        )
 
 
 def lower_bound_nodes(result: SimulateResult, template: Node) -> int:
@@ -163,6 +167,7 @@ def plan_capacity(
                   extenders=extenders)
     attempts += 1
     if good(base):
+        metrics.CAPACITY_NODES_ADDED.set(0)
         return CapacityPlan(0, base, attempts)
 
     # Exponential growth to bracket, seeded by the demand/supply estimate
@@ -241,4 +246,5 @@ def plan_capacity(
                     f"satisfies the plan ({best} nodes): simulate() is "
                     "nondeterministic"
                 )
+    metrics.CAPACITY_NODES_ADDED.set(best)
     return CapacityPlan(best, best_result, attempts)

@@ -25,12 +25,14 @@ extender written for the reference works against this engine unchanged.
 from __future__ import annotations
 
 import json
+import time
 import urllib.error
 import urllib.request
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..core.objects import Node, Pod
 from ..models.profiles import ExtenderConfig
+from ..utils import metrics
 from ..utils.tracing import log
 
 # framework.MaxNodeScore / extenderv1.MaxExtenderPriority (100 / 10)
@@ -134,22 +136,33 @@ class HTTPExtender:
             url, data=data, headers={"Content-Type": "application/json"},
             method="POST",
         )
+        t0 = time.monotonic()
         try:
-            # http_timeout_s == 0 means no client timeout (Go zero Timeout)
-            with urllib.request.urlopen(
-                req, timeout=self.cfg.http_timeout_s or None
-            ) as resp:
-                body = resp.read()
-                if resp.status != 200:
-                    raise ExtenderError(
-                        f"extender {url}: HTTP {resp.status}"
-                    )
-        except (urllib.error.URLError, OSError, TimeoutError) as e:
-            raise ExtenderError(f"extender {url}: {e}")
-        try:
-            return json.loads(body) or {}
-        except ValueError as e:
-            raise ExtenderError(f"extender {url}: invalid JSON response: {e}")
+            try:
+                # http_timeout_s == 0 means no client timeout (Go zero
+                # Timeout)
+                with urllib.request.urlopen(
+                    req, timeout=self.cfg.http_timeout_s or None
+                ) as resp:
+                    body = resp.read()
+                    if resp.status != 200:
+                        raise ExtenderError(
+                            f"extender {url}: HTTP {resp.status}"
+                        )
+            except (urllib.error.URLError, OSError, TimeoutError) as e:
+                raise ExtenderError(f"extender {url}: {e}")
+            try:
+                out = json.loads(body) or {}
+            except ValueError as e:
+                raise ExtenderError(
+                    f"extender {url}: invalid JSON response: {e}"
+                )
+        except ExtenderError:
+            metrics.EXTENDER_REQUESTS.inc(verb=verb, outcome="error")
+            raise
+        metrics.EXTENDER_REQUESTS.inc(verb=verb, outcome="ok")
+        metrics.EXTENDER_DURATION.observe(time.monotonic() - t0, verb=verb)
+        return out
 
     def _wire_args(self, pod: Pod, nodes: Sequence[Node]) -> dict:
         """ExtenderArgs{Pod, Nodes|NodeNames} — shared by filter and

@@ -43,6 +43,7 @@ from ..core.matcher import (
     untolerated_taint,
 )
 from ..core.objects import LabelSelector, Node, Pod
+from ..utils.tracing import span
 
 
 @dataclass
@@ -387,27 +388,30 @@ def try_preempt(
         def fits_many_fn(pod2, items):   # one-probe-per-call adapter
             return [fits(pod2, n, remaining) for n, remaining in items]
 
-    lanes: List[_Lane] = []
-    for node in nodes:
-        if not _static_unresolvable_ok(pod, node):
-            continue
-        got = _victim_candidates(
-            pod, bound_by_node.get(node.name, []), pdbs, pdb_allowed
+    with span("preempt", pod=pod.key) as sp:
+        lanes: List[_Lane] = []
+        for node in nodes:
+            if not _static_unresolvable_ok(pod, node):
+                continue
+            got = _victim_candidates(
+                pod, bound_by_node.get(node.name, []), pdbs, pdb_allowed
+            )
+            if got is None:
+                continue
+            keep, queue = got
+            lanes.append(_Lane(node=node, remaining=list(keep), queue=queue,
+                               victims=[]))
+        sp.meta["lanes"] = len(lanes)
+        candidates = _drive_lanes(pod, lanes, fits_many_fn)
+        # dryRunPreemption → CallExtenders → SelectCandidate (preempt(),
+        # default_preemption.go:141-176): extenders see the full candidate map
+        # between victim selection and the final pick.
+        candidates = call_preempt_extenders(
+            extenders, pod, candidates, bound_by_node, nodes
         )
-        if got is None:
-            continue
-        keep, queue = got
-        lanes.append(_Lane(node=node, remaining=list(keep), queue=queue,
-                           victims=[]))
-    candidates = _drive_lanes(pod, lanes, fits_many_fn)
-    # dryRunPreemption → CallExtenders → SelectCandidate (preempt(),
-    # default_preemption.go:141-176): extenders see the full candidate map
-    # between victim selection and the final pick.
-    candidates = call_preempt_extenders(
-        extenders, pod, candidates, bound_by_node, nodes
-    )
-    # An extender may have emptied a node's victim list while keeping the
-    # node: such a candidate means "schedulable here without evictions" from
-    # the extender's view, but the engine only reached preemption because the
-    # pod failed — drop victimless candidates like _drive_lanes does.
-    return pick_one_node([c for c in candidates if c.victims])
+        # An extender may have emptied a node's victim list while keeping the
+        # node: such a candidate means "schedulable here without evictions"
+        # from the extender's view, but the engine only reached preemption
+        # because the pod failed — drop victimless candidates like
+        # _drive_lanes does.
+        return pick_one_node([c for c in candidates if c.victims])

@@ -58,6 +58,7 @@ from ..ops.state import (
     carry_from_table,
     node_static_from_table,
 )
+from ..utils import metrics
 from ..utils.tracing import progress, span
 
 
@@ -151,6 +152,16 @@ def _reason_string(n_nodes: int, counts: np.ndarray) -> str:
     ]
     detail = ", ".join(parts) if parts else "no nodes in cluster"
     return f"0/{n_nodes} nodes are available: {detail}."
+
+
+def _count_filter_failures(counts: np.ndarray) -> None:
+    """Surface a per-filter rejection histogram (counts are per-(pod,node),
+    the same rows _reason_string prints) as
+    osim_filter_failure_total{reason=...}."""
+    for f in range(min(len(counts), NUM_FILTERS)):
+        c = int(counts[f])
+        if c > 0:
+            metrics.FILTER_FAILURE.inc(c, reason=FILTER_MESSAGES[f])
 
 
 # jitted preemption-probe programs keyed by (out-of-tree filter tuple,
@@ -449,16 +460,20 @@ class Simulator:
             "scheduled batch: %d/%d pods placed in %.2fs",
             scheduled, len(pods), sp.duration,
         )
+        metrics.SCHEDULING_ATTEMPTS.inc(len(pods))
         failed: List[UnscheduledPod] = []
         n_nodes = len(self.cluster.nodes)
+        fail_counts = np.zeros(reasons_np.shape[1], np.int64)
         for i, pod in enumerate(pods):
             ni = int(placed_np[i])
             if ni >= 0:
                 self._bind_placed(pod, ni, take_np[i], vg_np[i], dev_np[i])
             else:
+                fail_counts += reasons_np[i]
                 failed.append(
                     UnscheduledPod(pod, _reason_string(n_nodes, reasons_np[i]))
                 )
+        _count_filter_failures(fail_counts)
         return failed
 
     def _bind_placed(self, pod: Pod, ni: int, take_row, vg_row, dev_row) -> None:
@@ -486,6 +501,9 @@ class Simulator:
                 np.asarray(dev_row).copy(),
             )
         self._bound.append((pod, pod.node_name))
+        # the single commit point for successful placements (failed
+        # preemption retries roll back before ever reaching here)
+        metrics.SCHEDULE_RESULT.inc(result="scheduled")
 
     def _schedule_run_extenders(
         self, pods: List[Pod], weights, filter_on
@@ -597,6 +615,7 @@ class Simulator:
             "scheduled batch (extenders): %d/%d pods placed in %.2fs",
             scheduled, len(pods), sp.duration,
         )
+        metrics.SCHEDULING_ATTEMPTS.inc(len(pods))
         return failed
 
     def _name_index_map(self) -> Dict[str, int]:
@@ -623,6 +642,13 @@ class Simulator:
         for j in range(min(n_nodes, mask_np.shape[0])):
             if not mask_np[j] and ff_np[j] < NUM_FILTERS:
                 counts[ff_np[j]] += 1
+        _count_filter_failures(counts)
+        if n_device_feasible > 0:
+            # all device-feasible nodes were dropped by the extender chain;
+            # one bounded reason label (extender messages are free-form)
+            metrics.FILTER_FAILURE.inc(
+                n_device_feasible, reason="node(s) didn't pass extender filter"
+            )
         parts = [
             f"{int(counts[f])} {FILTER_MESSAGES[f]}"
             for f in range(NUM_FILTERS)
@@ -789,6 +815,9 @@ class Simulator:
             tuple(sorted(offs.items())),
         )
         probe = _PROBE_JIT_CACHE.get(key)
+        metrics.COMPILE_CACHE.inc(
+            event="hit" if probe is not None else "miss"
+        )
         if probe is None:
             extra_filters = self._extra_filters
             o = dict(offs)
@@ -923,11 +952,13 @@ class Simulator:
                 # reference aborts this pod's preemption with the error
                 # (default_preemption.go:373-374) — the pod stays failed
                 # with the extender's message appended
+                metrics.PREEMPTION_ATTEMPTS.inc(outcome="extender_error")
                 still_failed.append(
                     UnscheduledPod(pod=pod, reason=f"{u.reason}; {e}")
                 )
                 continue
             if res is None or not res.victims:
+                metrics.PREEMPTION_ATTEMPTS.inc(outcome="no_candidates")
                 still_failed.append(u)
                 continue
             # The host-side victim model covers resources only; the device
@@ -953,8 +984,15 @@ class Simulator:
                 self._storage_takes = takes
                 del self._preempted[n_pre:]
                 self._restore_bindings(fields)
+                metrics.PREEMPTION_ATTEMPTS.inc(outcome="retry_failed")
                 still_failed.extend(retry_failed)
             else:
+                # preemption committed: victims stay evicted — count them
+                # here, NOT in _evict (the rollback path above un-evicts)
+                metrics.PREEMPTION_ATTEMPTS.inc(outcome="succeeded")
+                metrics.SCHEDULE_RESULT.inc(
+                    len(res.victims), result="preempted"
+                )
                 bound_by_node = None  # placements changed; rebuild lazily
         return still_failed
 
@@ -1045,6 +1083,16 @@ class Simulator:
             else:
                 p.meta.annotations.pop(ANNO_GPU_INDEX, None)
 
+    @staticmethod
+    def _finalize_unscheduled(
+        failed: List[UnscheduledPod],
+    ) -> List[UnscheduledPod]:
+        """Unscheduled commit point: pods that survived the preemption pass
+        are final for this batch."""
+        if failed:
+            metrics.SCHEDULE_RESULT.inc(len(failed), result="unscheduled")
+        return failed
+
     def _apply_patch_hooks(self, kind: str, pods: List[Pod]) -> None:
         """WithPatchPodsFuncMap parity (simulator.go:243-249,471-500): let the
         caller mutate the pods generated from each workload kind before they
@@ -1074,6 +1122,10 @@ class Simulator:
                         if self._expand_cache is not None
                         else None
                     )
+                    if self._expand_cache is not None:
+                        metrics.EXPAND_CACHE.inc(
+                            event="hit" if cached is not None else "miss"
+                        )
                     fresh_entry: Dict[int, List[Pod]] = {}
                     fresh_validate: List[Pod] = []
                     for idx, obj in enumerate(app.objects):
@@ -1108,14 +1160,20 @@ class Simulator:
             result = SimulateResult()
             # RunCluster: the cluster's own pending pods schedule first.
             result.unscheduled.extend(
-                self._try_preemptions(
-                    self._schedule_batch_host(self._order(self._pending_cluster))
+                self._finalize_unscheduled(
+                    self._try_preemptions(
+                        self._schedule_batch_host(
+                            self._order(self._pending_cluster)
+                        )
+                    )
                 )
             )
             # ScheduleApp: each app in configured order.
             for pods in app_pods:
                 result.unscheduled.extend(
-                    self._try_preemptions(self._schedule_batch_host(pods))
+                    self._finalize_unscheduled(
+                        self._try_preemptions(self._schedule_batch_host(pods))
+                    )
                 )
 
             with span("decode-result"):

@@ -77,6 +77,7 @@ from .kernels import (
     resource_fail,
 )
 from .state import pod_rows_from_batch
+from ..utils import metrics as _metrics
 
 # Trajectories longer than this fall back to the per-pod grouped path (a node
 # that can absorb >512 copies of one pod implies an unrealistically small
@@ -90,6 +91,15 @@ PATH_COUNTS = {
     "sort": 0, "micro": 0, "scan": 0, "grouped": 0, "sort_fallback": 0,
     "domain": 0, "domain_fallback": 0, "domain_pallas": 0,
 }
+
+
+def _count_path(path: str, n: int = 1) -> None:
+    """Tally a strategy selection in PATH_COUNTS and mirror it into
+    osim_fast_path_total{path=...}."""
+    if n <= 0:
+        return
+    PATH_COUNTS[path] += n
+    _metrics.FAST_PATH.inc(n, path=path)
 
 # Max combined (domain-tuple, eligibility) classes for the domain-merge path;
 # groups whose nodes span more classes take the micro scan instead. Tests may
@@ -1566,7 +1576,7 @@ def schedule_batch_fast(
             and (force_fast or length >= max(3 * j_need // 2, 64))
         )
         if not use_fast:
-            PATH_COUNTS["grouped"] += 1
+            _count_path("grouped")
             done = 0
             while done < length:
                 n = min(length - done, max_group_chunk)
@@ -1642,13 +1652,13 @@ def schedule_batch_fast(
                 nodes_d[:length], jidx_d[:length], x, mono
             )
             if mono_ok:
-                PATH_COUNTS["sort"] += 1
+                _count_path("sort")
                 commit(got, carry_dev)
                 committed = True
             else:
                 # a balanced-allocation rise broke monotonicity — the merge
                 # argument doesn't hold, replay with the scan below
-                PATH_COUNTS["sort_fallback"] += 1
+                _count_path("sort_fallback")
 
         if not committed and flags.domain_aff:
             # Domain-merge path: O(Dc) scan state instead of O(N). The class
@@ -1681,17 +1691,17 @@ def schedule_batch_fast(
                     nodes_w[:length], jidx_w[:length], x_w, mono
                 )
                 if mono_ok:
-                    PATH_COUNTS["domain"] += 1
-                    PATH_COUNTS["domain_pallas"] += int(use_pallas)
+                    _count_path("domain")
+                    _count_path("domain_pallas", int(use_pallas))
                     commit(got, carry_dev)
                     committed = True
                 else:
                     # a rising lane sequence voids the within-class merge
                     # argument — replay with the micro scan
-                    PATH_COUNTS["domain_fallback"] += 1
+                    _count_path("domain_fallback")
 
         if not committed:
-            PATH_COUNTS["micro" if flags.micro_spread else "scan"] += 1
+            _count_path("micro" if flags.micro_spread else "scan")
             x = jnp.zeros(N, jnp.int32)
             chunks = []
             done = 0

@@ -34,6 +34,7 @@ from ..core.objects import (
     Node,
 )
 from ..engine.simulator import AppResource, ClusterResource, simulate
+from ..utils import metrics
 from ..utils.yamlio import objects_from_directory
 
 _busy = threading.Lock()
@@ -256,10 +257,26 @@ def _heap_profile() -> dict:
 
 
 class _Handler(BaseHTTPRequestHandler):
+    def _count(self, code: int) -> None:
+        from urllib.parse import urlparse
+
+        metrics.HTTP_REQUESTS.inc(
+            path=urlparse(self.path).path, code=str(code)
+        )
+
     def _send(self, code: int, payload: dict) -> None:
         data = json.dumps(payload).encode()
+        self._count(code)
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_text(self, data: bytes, content_type: str) -> None:
+        self._count(200)
+        self.send_response(200)
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
@@ -267,6 +284,13 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802
         if self.path == "/healthz":
             self._send(200, {"status": "ok"})
+        elif self.path == "/metrics":
+            # Prometheus text exposition (the kube-scheduler serves its
+            # metrics package at the same path) — see utils/metrics.py
+            self._send_text(
+                metrics.REGISTRY.render().encode(),
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
         elif self.path == "/debug/timings":
             # span trees (server.go:152's pprof registration analog), see
             # utils/tracing.py
@@ -297,6 +321,7 @@ class _Handler(BaseHTTPRequestHandler):
                         "profile": "/debug/pprof/profile?seconds=N",
                         "cmdline": "/debug/pprof/cmdline",
                         "timings": "/debug/timings",
+                        "metrics": "/metrics",
                     }
                 },
             )
@@ -310,12 +335,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, _heap_profile())
         elif self.path == "/test":
             # parity: GET /test returns the literal "test" (server.go:154-156)
-            data = b"test"
-            self.send_response(200)
-            self.send_header("Content-Type", "text/plain")
-            self.send_header("Content-Length", str(len(data)))
-            self.end_headers()
-            self.wfile.write(data)
+            self._send_text(b"test", "text/plain")
         else:
             self._send(404, {"error": "not found"})
 

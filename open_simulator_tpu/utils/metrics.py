@@ -1,0 +1,476 @@
+"""Aggregated scheduler metrics: counters, gauges, histograms + Prometheus text.
+
+The reference embeds the real kube-scheduler, whose `metrics` package is what
+operators tune against (e2e scheduling duration, attempt counts, schedule
+results).  This module is the TPU-port equivalent: a small, dependency-free,
+thread-safe registry with the kube-scheduler metric names carried over under
+the `osim_` prefix.
+
+Parity table (ours -> kube-scheduler):
+
+    osim_e2e_scheduling_duration_seconds  -> scheduler_e2e_scheduling_duration_seconds
+    osim_pod_scheduling_attempts_total    -> scheduler_pod_scheduling_attempts
+    osim_schedule_result_total{result=}   -> scheduler_schedule_attempts_total{result=}
+    osim_filter_failure_total{reason=}    -> (per-plugin UnschedulableAndUnresolvable counts)
+    osim_compile_cache_total{event=}      -> (no analogue: XLA jit-probe cache hit/miss)
+    osim_encode_duration_seconds          -> (no analogue: cluster/pod -> device-array encode)
+
+Exposure paths:
+  * `GET /metrics` on the HTTP server (Prometheus text format 0.0.4);
+  * `snapshot()` embedded in bench.py output JSON;
+  * every `tracing.span()` observes into a histogram via `observe_span()`.
+
+Hand-rolled on purpose: the image pins jax/numpy/pyyaml only, and the subset
+of prometheus_client we need (labeled counter/gauge/histogram + text render)
+is ~300 lines.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "REGISTRY",
+    "observe_span",
+]
+
+_METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+# kube-scheduler's e2e duration buckets: exponential from 1ms, factor 2,
+# 15 buckets (1ms .. ~16s).
+DEFAULT_BUCKETS: Tuple[float, ...] = tuple(0.001 * 2 ** i for i in range(15))
+
+
+def _escape_label_value(value: str) -> str:
+    return (
+        value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _format_value(value: float) -> str:
+    if value == math.inf:
+        return "+Inf"
+    if value == -math.inf:
+        return "-Inf"
+    if isinstance(value, float) and value.is_integer() and abs(value) < 2 ** 53:
+        return str(int(value))
+    return repr(float(value))
+
+
+def _format_labels(
+    labelnames: Sequence[str], labelvalues: Sequence[str], extra: str = ""
+) -> str:
+    """Render `{a="x",b="y"}` (or "" when there are no labels)."""
+    parts = [
+        '%s="%s"' % (n, _escape_label_value(v))
+        for n, v in zip(labelnames, labelvalues)
+    ]
+    if extra:
+        parts.append(extra)
+    if not parts:
+        return ""
+    return "{" + ",".join(parts) + "}"
+
+
+class _Metric:
+    """Base: one metric family; children keyed by label-value tuples."""
+
+    kind = "untyped"
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        labelnames: Sequence[str] = (),
+        lock: Optional[threading.RLock] = None,
+    ) -> None:
+        if not _METRIC_NAME_RE.match(name):
+            raise ValueError(f"invalid metric name: {name!r}")
+        for ln in labelnames:
+            if not _LABEL_NAME_RE.match(ln) or ln.startswith("__"):
+                raise ValueError(f"invalid label name: {ln!r}")
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = lock if lock is not None else threading.RLock()
+        self._children: Dict[Tuple[str, ...], object] = {}
+        if not self.labelnames:
+            # Label-less metrics expose a sample immediately (a counter that
+            # has never fired still renders as `name 0`).
+            self._child(())
+
+    # -- child management ---------------------------------------------------
+
+    def _new_child(self) -> object:
+        raise NotImplementedError
+
+    def _child(self, key: Tuple[str, ...]) -> object:
+        child = self._children.get(key)
+        if child is None:
+            child = self._children[key] = self._new_child()
+        return child
+
+    def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: labels {sorted(labels)} != "
+                f"declared {sorted(self.labelnames)}"
+            )
+        return tuple(str(labels[n]) for n in self.labelnames)
+
+    # -- rendering ----------------------------------------------------------
+
+    def _sample_lines(self) -> Iterable[str]:
+        raise NotImplementedError
+
+    def render(self) -> str:
+        with self._lock:
+            lines = [
+                f"# HELP {self.name} {_escape_help(self.help)}",
+                f"# TYPE {self.name} {self.kind}",
+            ]
+            lines.extend(self._sample_lines())
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> dict:
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def _new_child(self) -> list:
+        return [0.0]
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        if amount < 0:
+            raise ValueError(f"{self.name}: counters cannot decrease")
+        key = self._key(labels)
+        with self._lock:
+            self._child(key)[0] += amount
+
+    def value(self, **labels: str) -> float:
+        key = self._key(labels)
+        with self._lock:
+            child = self._children.get(key)
+            return child[0] if child is not None else 0.0
+
+    def _sample_lines(self) -> Iterable[str]:
+        for key in sorted(self._children):
+            yield "%s%s %s" % (
+                self.name,
+                _format_labels(self.labelnames, key),
+                _format_value(self._children[key][0]),
+            )
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            samples = [
+                {"labels": dict(zip(self.labelnames, key)), "value": val[0]}
+                for key, val in sorted(self._children.items())
+            ]
+        return {"type": self.kind, "help": self.help, "samples": samples}
+
+
+class Gauge(Counter):
+    kind = "gauge"
+
+    def set(self, value: float, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._child(key)[0] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._child(key)[0] += amount
+
+    def dec(self, amount: float = 1.0, **labels: str) -> None:
+        self.inc(-amount, **labels)
+
+
+class _HistChild:
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self, n_buckets: int) -> None:
+        self.counts = [0] * n_buckets  # per-bucket (non-cumulative) counts
+        self.sum = 0.0
+        self.count = 0
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        labelnames: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+        lock: Optional[threading.RLock] = None,
+    ) -> None:
+        ordered = sorted(float(b) for b in buckets)
+        if not ordered:
+            raise ValueError(f"{name}: histogram needs at least one bucket")
+        if ordered[-1] != math.inf:
+            ordered.append(math.inf)
+        self.buckets = tuple(ordered)
+        super().__init__(name, help, labelnames, lock=lock)
+
+    def _new_child(self) -> _HistChild:
+        return _HistChild(len(self.buckets))
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = self._key(labels)
+        value = float(value)
+        # leftmost bucket whose upper bound contains the value
+        idx = len(self.buckets) - 1
+        for i, ub in enumerate(self.buckets):
+            if value <= ub:
+                idx = i
+                break
+        with self._lock:
+            child = self._child(key)
+            child.counts[idx] += 1
+            child.sum += value
+            child.count += 1
+
+    def child_state(self, **labels: str) -> Tuple[List[int], float, int]:
+        """(cumulative bucket counts, sum, count) — test/snapshot helper."""
+        key = self._key(labels)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                return [0] * len(self.buckets), 0.0, 0
+            cum, running = [], 0
+            for c in child.counts:
+                running += c
+                cum.append(running)
+            return cum, child.sum, child.count
+
+    def _sample_lines(self) -> Iterable[str]:
+        for key in sorted(self._children):
+            child = self._children[key]
+            running = 0
+            for ub, c in zip(self.buckets, child.counts):
+                running += c
+                le = _format_labels(
+                    self.labelnames, key, extra='le="%s"' % _format_value(ub)
+                )
+                yield "%s_bucket%s %d" % (self.name, le, running)
+            plain = _format_labels(self.labelnames, key)
+            yield "%s_sum%s %s" % (self.name, plain, _format_value(child.sum))
+            yield "%s_count%s %d" % (self.name, plain, child.count)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            samples = []
+            for key, child in sorted(self._children.items()):
+                running, cum = 0, []
+                for c in child.counts:
+                    running += c
+                    cum.append(running)
+                samples.append(
+                    {
+                        "labels": dict(zip(self.labelnames, key)),
+                        "buckets": {
+                            _format_value(ub): n
+                            for ub, n in zip(self.buckets, cum)
+                        },
+                        "sum": child.sum,
+                        "count": child.count,
+                    }
+                )
+        return {"type": self.kind, "help": self.help, "samples": samples}
+
+
+class MetricsRegistry:
+    """Get-or-create registry; re-registering a name returns the existing
+    metric (and raises if the kind or label set changed)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _register(self, cls, name, help, labelnames, **kwargs) -> _Metric:
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if type(existing) is not cls or existing.labelnames != tuple(
+                    labelnames
+                ):
+                    raise ValueError(
+                        f"metric {name!r} already registered with a "
+                        f"different kind or label set"
+                    )
+                return existing
+            metric = cls(name, help, labelnames, lock=self._lock, **kwargs)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(
+        self, name: str, help: str = "", labelnames: Sequence[str] = ()
+    ) -> Counter:
+        return self._register(Counter, name, help, labelnames)
+
+    def gauge(
+        self, name: str, help: str = "", labelnames: Sequence[str] = ()
+    ) -> Gauge:
+        return self._register(Gauge, name, help, labelnames)
+
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        labelnames: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ) -> Histogram:
+        return self._register(
+            Histogram, name, help, labelnames, buckets=buckets
+        )
+
+    def render(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        with self._lock:
+            families = [self._metrics[n] for n in sorted(self._metrics)]
+        return "".join(f.render() for f in families)
+
+    def snapshot(self, include_empty: bool = False) -> Dict[str, dict]:
+        """JSON-friendly dump (embedded in bench.py output)."""
+        with self._lock:
+            families = sorted(self._metrics.items())
+        out = {}
+        for name, metric in families:
+            snap = metric.snapshot()
+            if not include_empty and not any(
+                s.get("value") or s.get("count") for s in snap["samples"]
+            ):
+                continue
+            out[name] = snap
+        return out
+
+    def reset(self) -> None:
+        """Zero all samples, keep registrations (test isolation helper)."""
+        with self._lock:
+            for metric in self._metrics.values():
+                metric._children.clear()
+                if not metric.labelnames:
+                    metric._child(())
+
+
+REGISTRY = MetricsRegistry()
+
+# ---------------------------------------------------------------------------
+# Well-known scheduler metrics (kube-scheduler name parity where an analogue
+# exists — see the parity table in the module docstring).
+# ---------------------------------------------------------------------------
+
+E2E_SCHEDULING = REGISTRY.histogram(
+    "osim_e2e_scheduling_duration_seconds",
+    "End-to-end simulate() duration (root span), seconds.",
+)
+ENCODE_DURATION = REGISTRY.histogram(
+    "osim_encode_duration_seconds",
+    "Pod/cluster -> device-array encode duration, seconds.",
+)
+SPAN_DURATION = REGISTRY.histogram(
+    "osim_span_duration_seconds",
+    "Duration of every tracing span, by span name, seconds.",
+    labelnames=("span",),
+)
+SCHEDULING_ATTEMPTS = REGISTRY.counter(
+    "osim_pod_scheduling_attempts_total",
+    "Pods entering a scheduling pass (preemption retries count again).",
+)
+SCHEDULE_RESULT = REGISTRY.counter(
+    "osim_schedule_result_total",
+    "Final scheduling outcomes: scheduled, unscheduled, or preempted "
+    "(victims evicted by a committed preemption).",
+    labelnames=("result",),
+)
+COMPILE_CACHE = REGISTRY.counter(
+    "osim_compile_cache_total",
+    "Device-probe jit cache lookups (miss = new XLA compile).",
+    labelnames=("event",),
+)
+EXPAND_CACHE = REGISTRY.counter(
+    "osim_expand_cache_total",
+    "Workload expand-cache lookups inside simulate().",
+    labelnames=("event",),
+)
+FILTER_FAILURE = REGISTRY.counter(
+    "osim_filter_failure_total",
+    "Per-(pod,node) filter rejections for pods that failed to schedule, "
+    "by kube failure-reason string.",
+    labelnames=("reason",),
+)
+FAST_PATH = REGISTRY.counter(
+    "osim_fast_path_total",
+    "schedule_batch_fast strategy selections, by path.",
+    labelnames=("path",),
+)
+PREEMPTION_ATTEMPTS = REGISTRY.counter(
+    "osim_preemption_attempts_total",
+    "Preemption attempts for unscheduled pods, by outcome.",
+    labelnames=("outcome",),
+)
+EXTENDER_REQUESTS = REGISTRY.counter(
+    "osim_extender_requests_total",
+    "HTTP scheduler-extender round trips, by verb and outcome.",
+    labelnames=("verb", "outcome"),
+)
+EXTENDER_DURATION = REGISTRY.histogram(
+    "osim_extender_duration_seconds",
+    "HTTP scheduler-extender round-trip duration, seconds.",
+    labelnames=("verb",),
+)
+HTTP_REQUESTS = REGISTRY.counter(
+    "osim_http_requests_total",
+    "Simulator HTTP server responses, by path and status code.",
+    labelnames=("path", "code"),
+)
+CAPACITY_PROBES = REGISTRY.counter(
+    "osim_capacity_probe_total",
+    "Capacity-planner simulate() probes (bracket + bisection).",
+)
+CAPACITY_NODES_ADDED = REGISTRY.gauge(
+    "osim_capacity_plan_nodes_added",
+    "Nodes added by the most recent capacity plan.",
+)
+APPLY_RUNS = REGISTRY.counter(
+    "osim_apply_total",
+    "simon-apply runs, by outcome.",
+    labelnames=("outcome",),
+)
+
+# Span names that map onto a dedicated kube-parity histogram; everything
+# else lands only in osim_span_duration_seconds{span=...}.
+_SPAN_HISTOGRAMS: Dict[str, Histogram] = {
+    "simulate": E2E_SCHEDULING,
+    "encode": ENCODE_DURATION,
+}
+
+
+def observe_span(name: str, seconds: float) -> None:
+    """Feed one finished tracing span into the histograms.
+
+    Called from tracing.span()'s finally block for *every* span, so the
+    import direction is tracing -> metrics (metrics must never import
+    tracing).
+    """
+    dedicated = _SPAN_HISTOGRAMS.get(name)
+    if dedicated is not None:
+        dedicated.observe(seconds)
+    SPAN_DURATION.observe(seconds, span=name)

@@ -14,13 +14,18 @@ Parity targets:
     endpoint serves recent span trees as JSON.
 
 Spans nest via a thread-local stack; finished roots are kept in a bounded
-ring buffer for the server endpoint. Overhead when disabled is two clock
-reads per span — safe to leave in hot host paths (device time is measured
-as host wall time around blocking calls, which is what a user can act on).
+ring buffer for the server endpoint (size: OSIM_SPAN_HISTORY, default 64).
+Every finished span also feeds the metrics histograms (utils/metrics.py),
+and when OSIM_TRACE_FILE is set, finished root trees are exported as Chrome
+trace events (load the file in Perfetto / chrome://tracing). Overhead when
+disabled is two clock reads per span — safe to leave in hot host paths
+(device time is measured as host wall time around blocking calls, which is
+what a user can act on).
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import threading
@@ -28,10 +33,25 @@ import time
 from contextlib import contextmanager
 from typing import List, Optional
 
+from . import metrics
+
 log = logging.getLogger("osim")
 
 SLOW_TRACE_S = float(os.environ.get("OSIM_SLOW_TRACE", "1.0"))
-_HISTORY_MAX = 64
+_HISTORY_DEFAULT = 64
+
+
+def _history_max() -> int:
+    """Ring-buffer size for /debug/timings; OSIM_SPAN_HISTORY overrides the
+    default of 64 so long bench runs can keep full histories. Read per root
+    close (cheap) so tests and long-lived servers can change it on the fly."""
+    raw = os.environ.get("OSIM_SPAN_HISTORY", "").strip()
+    if raw:
+        try:
+            return max(1, int(raw))
+        except ValueError:
+            log.warning("ignoring non-integer OSIM_SPAN_HISTORY=%r", raw)
+    return _HISTORY_DEFAULT
 
 
 class Span:
@@ -51,6 +71,7 @@ class Span:
     def to_dict(self) -> dict:
         d = {
             "name": self.name,
+            "start": round(self.start, 6),
             "duration_s": round(self.duration, 4),
         }
         if self.meta:
@@ -79,10 +100,11 @@ _history_lock = threading.Lock()
 
 @contextmanager
 def span(name: str, **meta):
-    """Time a phase. Nested spans build a tree; when a ROOT span closes it is
-    recorded for /debug/timings, logged at DEBUG, and escalated to WARNING
-    with its full subtree when slower than OSIM_SLOW_TRACE seconds (the
-    LogIfLong analog)."""
+    """Time a phase. Nested spans build a tree; every finished span observes
+    into the metrics histograms, and when a ROOT span closes it is recorded
+    for /debug/timings, exported to OSIM_TRACE_FILE (if set), logged at
+    DEBUG, and escalated to WARNING with its full subtree when slower than
+    OSIM_SLOW_TRACE seconds (the LogIfLong analog)."""
     s = Span(name)
     if meta:
         s.meta.update(meta)
@@ -95,10 +117,12 @@ def span(name: str, **meta):
     finally:
         s.end = time.time()
         _tracer.stack.pop()
+        metrics.observe_span(s.name, s.end - s.start)
         if parent is None:
             with _history_lock:
                 _history.append(s.to_dict())
-                del _history[:-_HISTORY_MAX]
+                del _history[:-_history_max()]
+            _maybe_export_trace(s)
             if s.duration > SLOW_TRACE_S:
                 log.warning("slow trace (> %.1fs):\n%s", SLOW_TRACE_S, s.render())
             else:
@@ -109,6 +133,77 @@ def recent_timings() -> List[dict]:
     """Recent root span trees, oldest first (the /debug/timings payload)."""
     with _history_lock:
         return list(_history)
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace-event export (OSIM_TRACE_FILE)
+# ---------------------------------------------------------------------------
+#
+# Each finished root span tree is flattened into "X" (complete) events with
+# epoch-microsecond `ts` and `dur`, and the whole accumulated event list is
+# rewritten to the file — roots are rare (one per simulate call), so the
+# rewrite is cheap and the file is valid JSON after every root, even if the
+# process dies mid-run. Epoch microseconds stay below 2^53, so `ts` survives
+# the JSON double round trip.
+
+_trace_lock = threading.Lock()
+_trace_events: List[dict] = []
+_TRACE_MAX_EVENTS = 250_000  # backstop for long-lived servers
+_trace_overflow_logged = False
+
+
+def _span_events(s: Span, pid: int, tid: int, out: List[dict]) -> None:
+    ev = {
+        "name": s.name,
+        "cat": "osim",
+        "ph": "X",
+        "ts": s.start * 1e6,
+        "dur": max(s.duration, 0.0) * 1e6,
+        "pid": pid,
+        "tid": tid,
+    }
+    if s.meta:
+        ev["args"] = dict(s.meta)
+    out.append(ev)
+    for c in s.children:
+        _span_events(c, pid, tid, out)
+
+
+def _maybe_export_trace(root: Span) -> None:
+    path = os.environ.get("OSIM_TRACE_FILE", "").strip()
+    if not path:
+        return
+    global _trace_overflow_logged
+    events: List[dict] = []
+    _span_events(root, os.getpid(), threading.get_ident(), events)
+    with _trace_lock:
+        if len(_trace_events) + len(events) > _TRACE_MAX_EVENTS:
+            if not _trace_overflow_logged:
+                _trace_overflow_logged = True
+                log.warning(
+                    "OSIM_TRACE_FILE: dropping events beyond %d; "
+                    "restart the process to start a fresh trace",
+                    _TRACE_MAX_EVENTS,
+                )
+            return
+        _trace_events.extend(events)
+        payload = {"traceEvents": list(_trace_events),
+                   "displayTimeUnit": "ms"}
+        try:
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+        except OSError as exc:
+            log.warning("OSIM_TRACE_FILE write failed: %s", exc)
+
+
+def reset_trace_events() -> None:
+    """Drop accumulated trace events (test isolation / manual truncation)."""
+    global _trace_overflow_logged
+    with _trace_lock:
+        _trace_events.clear()
+        _trace_overflow_logged = False
 
 
 def progress(fmt: str, *args) -> None:
@@ -125,15 +220,27 @@ _LEVELS = {
     "error": logging.ERROR,
 }
 
+_FORMAT = "%(asctime)s %(levelname)-7s %(name)s: %(message)s"
+_log_handler: Optional[logging.Handler] = None
+
 
 def init_logging(default: str = "info") -> None:
     """Honor the LogLevel env exactly like cmd/simon/simon.go:46-66 (invalid
-    values fall back to the default, case-insensitive)."""
+    values fall back to the default, case-insensitive).
+
+    Idempotent: `logging.basicConfig` is a no-op once any root handler
+    exists (e.g. under pytest, or on a second serve() call), which used to
+    silently ignore LogLevel changes. The `osim` logger now owns a single
+    dedicated stderr handler whose level tracks LogLevel on every call;
+    propagation stays on so root-level capture (pytest caplog) still works.
+    """
     level = _LEVELS.get(os.environ.get("LogLevel", default).strip().lower())
     if level is None:
         level = _LEVELS[default]
-    logging.basicConfig(
-        level=level,
-        format="%(asctime)s %(levelname)-7s %(name)s: %(message)s",
-    )
+    global _log_handler
+    if _log_handler is None:
+        _log_handler = logging.StreamHandler()
+        _log_handler.setFormatter(logging.Formatter(_FORMAT))
+        log.addHandler(_log_handler)
+    _log_handler.setLevel(level)
     log.setLevel(level)

@@ -29,7 +29,10 @@ def test_canary_segment_routes_to_headline(monkeypatch, capsys):
     assert rc == 0
     assert seen["sizes"] == (2_000, 200)
     out = capsys.readouterr().out.strip().splitlines()[-1]
-    assert json.loads(out) == {"ok": True}
+    parsed = json.loads(out)
+    assert parsed["ok"] is True
+    # every segment's JSON carries its process's metrics snapshot
+    assert isinstance(parsed["metrics"], dict)
 
 
 def test_canary_has_tighter_deadline_than_headline():

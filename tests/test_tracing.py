@@ -21,6 +21,36 @@ def test_span_nesting_and_history():
     assert [c["name"] for c in latest["children"]] == ["child", "child2"]
 
 
+def test_span_to_dict_records_start_timestamp():
+    import time
+
+    before = time.time()
+    with tracing.span("stamped"):
+        pass
+    latest = tracing.recent_timings()[-1]
+    assert before - 1 <= latest["start"] <= time.time()
+    # entries are orderable by wall clock
+    with tracing.span("stamped2"):
+        pass
+    t2 = tracing.recent_timings()[-1]
+    assert t2["start"] >= latest["start"]
+
+
+def test_span_history_env_override(monkeypatch):
+    monkeypatch.setenv("OSIM_SPAN_HISTORY", "3")
+    for i in range(5):
+        with tracing.span(f"h{i}"):
+            pass
+    names = [r["name"] for r in tracing.recent_timings()]
+    assert len(names) == 3
+    assert names == ["h2", "h3", "h4"]
+    # malformed values fall back to the default instead of raising
+    monkeypatch.setenv("OSIM_SPAN_HISTORY", "lots")
+    with tracing.span("h5"):
+        pass
+    assert tracing.recent_timings()[-1]["name"] == "h5"
+
+
 def test_slow_trace_logs_warning(monkeypatch, caplog):
     monkeypatch.setattr(tracing, "SLOW_TRACE_S", 0.0)
     with caplog.at_level(logging.WARNING, logger="osim"):
