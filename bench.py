@@ -694,10 +694,12 @@ def _segment_main(name: str, pods: int, nodes: int) -> int:
     from open_simulator_tpu.utils.platform import (
         enable_compilation_cache,
         ensure_platform,
+        install_compile_listener,
     )
 
     ensure_platform()
     enable_compilation_cache()
+    install_compile_listener()
     try:
         if name in ("headline", "canary", "headline_mid"):
             out = _run_headline(pods, nodes)
@@ -709,9 +711,12 @@ def _segment_main(name: str, pods: int, nodes: int) -> int:
         # phase histograms / compile-cache behavior / failure reasons for
         # this segment's process (each segment is its own child, so the
         # snapshot is per-segment)
-        from open_simulator_tpu.utils.metrics import REGISTRY
+        from open_simulator_tpu.utils.metrics import COMPILE_CACHE, REGISTRY
 
         out["metrics"] = REGISTRY.snapshot()
+        # explicit top-of-doc compile count so BENCH_*.json diffs catch
+        # recompile regressions without digging through the metrics tree
+        out["compiles"] = int(COMPILE_CACHE.value(event="backend_compile"))
     print(json.dumps(out), flush=True)
     return 0
 
@@ -806,15 +811,18 @@ def main() -> int:
         from open_simulator_tpu.utils.platform import (
             enable_compilation_cache,
             ensure_platform,
+            install_compile_listener,
         )
 
         ensure_platform()
         enable_compilation_cache()
+        install_compile_listener()
         result = _run_headline(args.pods, args.nodes)
         result.update(backend_info)
-        from open_simulator_tpu.utils.metrics import REGISTRY
+        from open_simulator_tpu.utils.metrics import COMPILE_CACHE, REGISTRY
 
         result["metrics"] = REGISTRY.snapshot()
+        result["compiles"] = int(COMPILE_CACHE.value(event="backend_compile"))
         print(json.dumps(result))
         return 0
 

@@ -1,0 +1,22 @@
+"""Static-analysis subsystem: AST lint pass + jaxpr auditor.
+
+The engine's TPU-native advantage rests on contracts the runtime cannot
+check for free:
+
+* jitted kernels stay pure — no host syncs, tracer coercions, or
+  environment reads inside traced code (``analysis.rules.purity``);
+* every dynamic size flows through the ``round_up``/``_bucket`` shape
+  family so the jit cache hits across capacity iterations
+  (``analysis.rules.shapes``);
+* arithmetic stays in the f32/i32 regime that keeps pod counts exact
+  below 2**24 (``analysis.rules.dtype``).
+
+``analysis.lint`` enforces these with a pure-AST pass (no jax import —
+fast enough for a pre-commit hook); ``analysis.jaxpr_audit`` traces the
+registered fast-path kernels and inspects the actual jaxprs, catching
+what syntax alone cannot.
+"""
+
+from .lint import Finding, LintReport, iter_rules, run_lint
+
+__all__ = ["Finding", "LintReport", "iter_rules", "run_lint"]

@@ -1,0 +1,575 @@
+"""jaxpr auditor: trace the fast-path kernels and inspect what XLA sees.
+
+The AST lint (``analysis.lint``) catches what syntax shows; this layer
+catches what only tracing shows. It builds one canonical encoded state —
+a small synthetic cluster at the same bucket family production uses
+(``round_up(n_nodes, 64)`` node axis, ``_bucket``-padded pod groups) —
+runs the real host dispatchers over it while *capturing* every jit-entry
+call, then retraces each captured call with ``Function.trace`` and walks
+the jaxpr:
+
+* **forbidden primitives** — host callbacks and explicit transfers
+  (``pure_callback``, ``io_callback``, ``debug_callback``, infeed /
+  outfeed, ``device_put``...) mean a host round trip inside the kernel;
+* **wide avals** — any f64/i64/u64/c128 intermediate means the f32/i32
+  exactness regime leaked (x64 off: silent downcast hid the intent;
+  x64 on: doubled HBM traffic).
+
+Capturing at the dispatcher boundary (instead of hand-building each
+kernel's arguments) keeps the audit signature-proof: when a kernel gains
+a parameter the capture follows automatically, and the audit inspects
+exactly the (shapes, dtypes, static values) production uses.
+
+The recompile guard (:func:`run_recompile_guard`) is the dynamic half of
+the shape-discipline story: it runs a small capacity-planning sweep —
+the workload whose add-node search motivates bucketing in the first
+place — and asserts the number of XLA backend compiles stays within the
+declared shape-family budget, cross-checking its own count against the
+``osim_compile_cache_total{event="backend_compile"}`` counter fed by
+``utils.platform.install_compile_listener``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+#: Primitives that imply a host round trip or an explicit transfer inside
+#: traced code. Non-empty by contract (the audit refuses to run otherwise —
+#: an empty set would vacuously pass).
+FORBIDDEN_PRIMITIVES = frozenset(
+    {
+        "pure_callback",
+        "io_callback",
+        "debug_callback",
+        "callback",
+        "outside_call",
+        "host_callback_call",
+        "infeed",
+        "outfeed",
+        "device_put",
+        "copy_to_host",
+    }
+)
+
+WIDE_DTYPES = frozenset({"float64", "int64", "uint64", "complex128"})
+
+#: jit entry points per module; captured while the canonical dispatch runs.
+AUDIT_TARGETS: Dict[str, Tuple[str, ...]] = {
+    "open_simulator_tpu.ops.fast": (
+        "build_trajectory",
+        "sort_select",
+        "cur_at",
+        "light_scan",
+        "domain_select",
+        "light_reasons",
+        "gather_takes",
+        "exit_carry",
+    ),
+    "open_simulator_tpu.ops.grouped": ("_group_jit",),
+    "open_simulator_tpu.ops.kernels": ("schedule_batch", "probe_step", "commit_step"),
+}
+
+#: entries the canonical state MUST exercise — a refactor that silently
+#: stops routing through one of these should fail the audit, not shrink it.
+REQUIRED_COVERAGE = frozenset(
+    {
+        "ops.fast:build_trajectory",
+        "ops.fast:sort_select",
+        "ops.fast:light_scan",
+        "ops.fast:domain_select",
+        "ops.fast:light_reasons",
+        "ops.fast:cur_at",
+        "ops.fast:gather_takes",
+        "ops.fast:exit_carry",
+        "ops.grouped:_group_jit",
+        "ops.kernels:schedule_batch",
+        "ops.kernels:probe_step",
+        "ops.kernels:commit_step",
+    }
+)
+
+#: XLA backend-compile budget for the capacity sweep: every probe of the
+#: search shares one node-bucket per phase, so the whole sweep should stay
+#: within a handful of shape families (kernels x {bracket bucket, pinned
+#: bisection bucket}), not one compile per probe.
+RECOMPILE_BUDGET = 48
+
+
+@dataclasses.dataclass
+class TargetReport:
+    name: str
+    traced: bool
+    n_eqns: int = 0
+    primitives: List[str] = dataclasses.field(default_factory=list)
+    forbidden: List[str] = dataclasses.field(default_factory=list)
+    wide_avals: List[str] = dataclasses.field(default_factory=list)
+    error: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return self.traced and not self.forbidden and not self.wide_avals and not self.error
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "traced": self.traced,
+            "ok": self.ok,
+            "n_eqns": self.n_eqns,
+            "forbidden": self.forbidden,
+            "wide_avals": self.wide_avals,
+            "error": self.error,
+        }
+
+
+@dataclasses.dataclass
+class AuditReport:
+    targets: List[TargetReport]
+    uncovered: List[str]
+    required_missing: List[str]
+
+    @property
+    def ok(self) -> bool:
+        return not self.required_missing and all(t.ok for t in self.targets)
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "targets": [t.to_dict() for t in self.targets],
+            "uncovered": self.uncovered,
+            "required_missing": self.required_missing,
+            "forbidden_primitives": sorted(FORBIDDEN_PRIMITIVES),
+        }
+
+    def render_text(self) -> str:
+        out = []
+        for t in sorted(self.targets, key=lambda t: t.name):
+            status = "ok" if t.ok else "FAIL"
+            detail = f"{t.n_eqns} eqns"
+            if t.forbidden:
+                detail += f"; forbidden: {', '.join(t.forbidden)}"
+            if t.wide_avals:
+                detail += f"; wide avals: {', '.join(t.wide_avals[:4])}"
+            if t.error:
+                detail += f"; error: {t.error}"
+            out.append(f"  {status:4s} {t.name} ({detail})")
+        if self.uncovered:
+            out.append(f"  not exercised by canonical state: {', '.join(self.uncovered)}")
+        if self.required_missing:
+            out.append(f"  REQUIRED but missing: {', '.join(self.required_missing)}")
+        out.append(f"jaxpr audit: {'ok' if self.ok else 'FAILED'}")
+        return "\n".join(out)
+
+
+# --------------------------------------------------------------------------
+# canonical state
+
+
+def canonical_state():
+    """A small synthetic cluster encoded at the production bucket family.
+
+    24 nodes -> the 64-node `round_up` bucket `encode_nodes` always uses;
+    four pod templates tiled into runs that deterministically exercise the
+    dispatcher's strategies: a large plain group (trajectory + sort path),
+    a zonal topology-spread group (domain path), a hostname-spread group
+    (general light_scan body), and an infeasible group (light_reasons
+    attribution).
+    """
+    from ..core.objects import Node, Pod
+    from ..ops.encode import (
+        Encoder,
+        encode_nodes,
+        encode_pods,
+        initial_anti_counts,
+        initial_port_counts,
+        initial_selector_counts,
+    )
+    from ..ops.state import carry_from_table, node_static_from_table
+    from ..ops.tile import tile_pod_batch
+
+    nodes = [
+        Node.from_dict(
+            {
+                "metadata": {
+                    "name": f"audit-{i}",
+                    "labels": {
+                        "kubernetes.io/hostname": f"audit-{i}",
+                        "topology.kubernetes.io/zone": f"az-{i % 3}",
+                    },
+                },
+                "spec": {},
+                "status": {
+                    "allocatable": {"cpu": "16", "memory": "32Gi", "pods": "110"}
+                },
+            }
+        )
+        for i in range(24)
+    ]
+
+    def pod(name, cpu, labels=None, spec_extra=None):
+        spec = {
+            "containers": [
+                {
+                    "name": "c",
+                    "resources": {"requests": {"cpu": cpu, "memory": "256Mi"}},
+                }
+            ]
+        }
+        spec.update(spec_extra or {})
+        return Pod.from_dict(
+            {
+                "metadata": {"name": name, "namespace": "audit", "labels": labels or {}},
+                "spec": spec,
+            }
+        )
+
+    plain = pod("plain", "100m")
+    spread = pod(
+        "spread",
+        "100m",
+        labels={"app": "spread"},
+        spec_extra={
+            "topologySpreadConstraints": [
+                {
+                    "maxSkew": 1,
+                    "topologyKey": "topology.kubernetes.io/zone",
+                    "whenUnsatisfiable": "DoNotSchedule",
+                    "labelSelector": {"matchLabels": {"app": "spread"}},
+                }
+            ]
+        },
+    )
+    # hostname-keyed spread counts per node (not per domain), which voids
+    # both the sort path and the domain merge -> the general light_scan body
+    host_spread = pod(
+        "hspread",
+        "100m",
+        labels={"app": "hspread"},
+        spec_extra={
+            "topologySpreadConstraints": [
+                {
+                    "maxSkew": 1,
+                    "topologyKey": "kubernetes.io/hostname",
+                    "whenUnsatisfiable": "DoNotSchedule",
+                    "labelSelector": {"matchLabels": {"app": "hspread"}},
+                }
+            ]
+        },
+    )
+    infeasible = pod("huge", "64")  # > any node's 16 cpu -> unschedulable
+
+    templates = [plain, spread, host_spread, infeasible]
+    counts = [220, 60, 50, 30]
+
+    enc = Encoder()
+    enc.register_pods(templates)
+    table = encode_nodes(enc, nodes)
+    batch = tile_pod_batch(encode_pods(enc, templates), counts)
+    ns = node_static_from_table(enc, table)
+    carry = carry_from_table(
+        table,
+        initial_selector_counts(enc, table, []),
+        port_counts=initial_port_counts(enc, table, []),
+        anti_counts=initial_anti_counts(enc, table, []),
+    )
+    return ns, carry, batch
+
+
+# --------------------------------------------------------------------------
+# capture + trace
+
+
+@dataclasses.dataclass
+class _Captured:
+    name: str
+    fn: Any  # the original jitted Function
+    args: tuple
+    kwargs: dict
+
+
+def _is_concrete(x: Any) -> bool:
+    import jax
+
+    return not any(
+        isinstance(leaf, jax.core.Tracer) for leaf in jax.tree.leaves(x)
+    )
+
+
+def _short(module: str, attr: str) -> str:
+    return f"{module.split('.', 1)[1]}:{attr}"
+
+
+def _capture_calls() -> List[_Captured]:
+    """Run the host dispatchers over the canonical state with every jit
+    entry wrapped by a recorder; return first-call args per entry."""
+    import importlib
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    captured: Dict[str, _Captured] = {}
+    patches: List[Tuple[Any, str, Any]] = []
+    try:
+        for module_name, attrs in AUDIT_TARGETS.items():
+            module = importlib.import_module(module_name)
+            for attr in attrs:
+                original = getattr(module, attr)
+                name = _short(module_name, attr)
+
+                def recorder(*args, _original=original, _name=name, **kwargs):
+                    if _name not in captured and _is_concrete((args, kwargs)):
+                        captured[_name] = _Captured(_name, _original, args, kwargs)
+                    return _original(*args, **kwargs)
+
+                setattr(module, attr, recorder)
+                patches.append((module, attr, original))
+
+        fast = importlib.import_module("open_simulator_tpu.ops.fast")
+        grouped = importlib.import_module("open_simulator_tpu.ops.grouped")
+        kernels = importlib.import_module("open_simulator_tpu.ops.kernels")
+        state_mod = importlib.import_module("open_simulator_tpu.ops.state")
+
+        ns, carry, batch = canonical_state()
+        weights = kernels.weights_array()
+
+        # the trajectory dispatcher: plain group -> build_trajectory +
+        # light_scan (+ cur_at/gather_takes/exit_carry), spread group ->
+        # domain path, infeasible group -> light_reasons
+        fast.schedule_batch_fast(ns, carry, batch, weights, force_fast=True)
+        # the per-pod grouped scan (`_group_jit`)
+        grouped.schedule_batch_grouped(ns, carry, batch, weights)
+        # the sequential oracle + the extender-path single-pod entries
+        rows = state_mod.pod_rows_from_batch(batch)
+        kernels.schedule_batch(ns, carry, rows, weights)
+        row0 = _tree_first(rows)
+        kernels.probe_step(ns, carry, row0, weights)
+        kernels.commit_step(ns, carry, row0, jnp.int32(0))
+        del np
+    finally:
+        for module, attr, original in patches:
+            setattr(module, attr, original)
+    return list(captured.values())
+
+
+def _tree_first(rows):
+    import jax
+
+    return jax.tree.map(lambda a: a[0], rows)
+
+
+def _iter_eqns(jaxpr) -> Iterator[Any]:
+    """All equations of a (possibly nested) jaxpr: pjit bodies, scan/cond/
+    while branches — anything carrying a sub-jaxpr in its params."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                yield from _iter_eqns(sub)
+
+
+def _sub_jaxprs(v: Any) -> Iterator[Any]:
+    if hasattr(v, "jaxpr") and hasattr(v.jaxpr, "eqns"):  # ClosedJaxpr
+        yield v.jaxpr
+    elif hasattr(v, "eqns"):  # raw Jaxpr
+        yield v
+    elif isinstance(v, (list, tuple)):
+        for item in v:
+            yield from _sub_jaxprs(item)
+
+
+def _audit_one(cap: _Captured) -> TargetReport:
+    report = TargetReport(name=cap.name, traced=False)
+    try:
+        closed = cap.fn.trace(*cap.args, **cap.kwargs).jaxpr
+    except Exception as exc:  # pragma: no cover - trace failure is a finding
+        report.error = f"trace failed: {exc!r}"
+        return report
+    report.traced = True
+    prims = set()
+    wide = set()
+    forbidden = set()
+    for eqn in _iter_eqns(closed.jaxpr):
+        pname = eqn.primitive.name
+        prims.add(pname)
+        if pname in FORBIDDEN_PRIMITIVES:
+            forbidden.add(pname)
+        report.n_eqns += 1
+        for var in list(eqn.invars) + list(eqn.outvars):
+            aval = getattr(var, "aval", None)
+            dtype = getattr(aval, "dtype", None)
+            if dtype is not None and dtype.name in WIDE_DTYPES:
+                wide.add(f"{pname}:{dtype.name}")
+    report.primitives = sorted(prims)
+    report.forbidden = sorted(forbidden)
+    report.wide_avals = sorted(wide)
+    return report
+
+
+def run_audit() -> AuditReport:
+    """Capture + retrace every registered kernel; see module docstring."""
+    if not FORBIDDEN_PRIMITIVES:
+        raise RuntimeError("forbidden-primitive set must be non-empty")
+    caps = _capture_calls()
+    by_name = {c.name: c for c in caps}
+    targets = [_audit_one(c) for c in caps]
+    all_names = {
+        _short(m, a) for m, attrs in AUDIT_TARGETS.items() for a in attrs
+    }
+    uncovered = sorted(all_names - set(by_name))
+    required_missing = sorted(REQUIRED_COVERAGE - set(by_name))
+    return AuditReport(
+        targets=targets, uncovered=uncovered, required_missing=required_missing
+    )
+
+
+# --------------------------------------------------------------------------
+# recompile guard
+
+
+@dataclasses.dataclass
+class GuardResult:
+    compiles: int
+    budget: int
+    metric_compiles: int
+    nodes_added: int
+    attempts: int
+
+    @property
+    def ok(self) -> bool:
+        return (
+            0 < self.compiles <= self.budget
+            and self.compiles == self.metric_compiles
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "compiles": self.compiles,
+            "budget": self.budget,
+            "metric_compiles": self.metric_compiles,
+            "nodes_added": self.nodes_added,
+            "attempts": self.attempts,
+        }
+
+    def render_text(self) -> str:
+        return (
+            f"recompile guard: {'ok' if self.ok else 'FAILED'} — "
+            f"{self.compiles} backend compiles (budget {self.budget}, "
+            f"metric cross-check {self.metric_compiles}) over a capacity "
+            f"sweep adding {self.nodes_added} node(s) in {self.attempts} "
+            "probes"
+        )
+
+
+def _sweep_fixture():
+    """An overloaded 3-node cluster + one Deployment that cannot fit, plus
+    the clone template — the smallest sweep that makes plan_capacity walk
+    its exponential + bisection phases."""
+    from ..core.objects import Node
+    from ..engine.simulator import AppResource, ClusterResource
+
+    def node(name: str) -> Node:
+        return Node.from_dict(
+            {
+                "metadata": {
+                    "name": name,
+                    "labels": {"kubernetes.io/hostname": name},
+                },
+                "spec": {},
+                "status": {
+                    "allocatable": {"cpu": "8", "memory": "16Gi", "pods": "110"}
+                },
+            }
+        )
+
+    cluster = ClusterResource(nodes=[node(f"guard-{i}") for i in range(3)])
+    deployment = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": "load", "namespace": "guard"},
+        "spec": {
+            "replicas": 40,
+            "selector": {"matchLabels": {"app": "load"}},
+            "template": {
+                "metadata": {"labels": {"app": "load"}},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "c",
+                            "image": "load:v1",
+                            "resources": {
+                                "requests": {"cpu": "2", "memory": "1Gi"}
+                            },
+                        }
+                    ]
+                },
+            },
+        },
+    }
+    apps = [AppResource(name="guard", objects=[deployment])]
+    return cluster, apps, node("guard-template")
+
+
+def _backend_compiles() -> int:
+    from ..utils import metrics
+
+    return int(metrics.COMPILE_CACHE.value(event="backend_compile"))
+
+
+def run_recompile_guard(budget: int = RECOMPILE_BUDGET) -> GuardResult:
+    """Run the canonical capacity sweep and bound its XLA compile count.
+
+    Counts via the jax.monitoring backend-compile event (installed into the
+    metrics registry by install_compile_listener) and cross-checks the
+    local listener count against the registry's
+    osim_compile_cache_total{event="backend_compile"} value.
+    """
+    from ..engine.capacity import plan_capacity
+    from ..utils.platform import install_compile_listener
+
+    if not install_compile_listener():
+        raise RuntimeError("jax.monitoring unavailable; cannot count compiles")
+
+    local = {"n": 0}
+
+    def _local_listener(event: str, duration: float, **kwargs) -> None:
+        if event.endswith("backend_compile_duration"):
+            local["n"] += 1
+
+    from jax import monitoring
+
+    monitoring.register_event_duration_secs_listener(_local_listener)
+    metric_before = _backend_compiles()
+    try:
+        cluster, apps, template = _sweep_fixture()
+        plan = plan_capacity(cluster, apps, template, max_new_nodes=256)
+    finally:
+        try:
+            monitoring._unregister_event_duration_listener_by_callback(
+                _local_listener
+            )
+        except Exception:
+            pass
+    if plan is None:
+        raise RuntimeError("recompile-guard sweep did not converge")
+    metric_delta = _backend_compiles() - metric_before
+    return GuardResult(
+        compiles=local["n"],
+        budget=budget,
+        metric_compiles=metric_delta,
+        nodes_added=plan.nodes_added,
+        attempts=plan.attempts,
+    )
+
+
+def report_json(audit: Optional[AuditReport], guard: Optional[GuardResult]) -> str:
+    return json.dumps(
+        {
+            "version": 1,
+            "jaxpr_audit": audit.to_dict() if audit is not None else None,
+            "recompile_guard": guard.to_dict() if guard is not None else None,
+        },
+        indent=2,
+        sort_keys=True,
+    )

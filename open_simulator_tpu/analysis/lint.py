@@ -1,0 +1,518 @@
+"""AST lint engine: package walker, jit-reachability, rule driver.
+
+Pure stdlib (``ast`` only — importing jax just to lint would pay XLA
+startup on every pre-commit run). The engine builds a package-wide
+model once, computes which functions are reachable from a ``jax.jit``
+entry point, then hands a :class:`LintContext` to every registered rule.
+
+Jit entry points are recognised in all three spellings the codebase
+uses::
+
+    @jax.jit                                   # bare decorator
+    @functools.partial(jax.jit, static_argnames=("n",))
+    _group_jit = jax.jit(schedule_group, static_argnames=(...))
+
+Reachability is a worklist over the call graph: any function called by
+name from a jit-reachable body (including function-valued arguments to
+``jax.lax.scan``/``cond``/``while_loop``/``switch``/``fori_loop`` and
+``jax.vmap``) is itself jit-reachable. ``from .sibling import helper``
+imports are resolved within the package, so a helper in ``ops/encode.py``
+called from a jitted body in ``ops/fast.py`` is covered.
+
+Suppressions: append ``# osim: lint-ok[rule-id]`` to the flagged line.
+Every suppression should carry a one-line justification on the same or
+the preceding line.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+SUPPRESS_RE = re.compile(r"#\s*osim:\s*lint-ok\[([a-z0-9-]+(?:\s*,\s*[a-z0-9-]+)*)\]")
+
+#: jax higher-order functions whose function-valued arguments are traced.
+_TRACED_HOFS = {
+    "scan",
+    "cond",
+    "while_loop",
+    "switch",
+    "fori_loop",
+    "vmap",
+    "checkpoint",
+    "remat",
+    "custom_vjp",
+    "custom_jvp",
+}
+
+
+@dataclasses.dataclass
+class Finding:
+    """One lint violation. ``jit_root`` names the jit entry point that makes
+    the enclosing function traced (empty for rules that apply anywhere)."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    jit_root: str = ""
+    suppressed: bool = False
+
+    def to_dict(self) -> dict:
+        d = {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+        if self.jit_root:
+            d["jit_root"] = self.jit_root
+        if self.suppressed:
+            d["suppressed"] = True
+        return d
+
+    def render(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        ctx = f" [via {self.jit_root}]" if self.jit_root else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}{ctx}{tag}"
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    """A function def somewhere in a module (module-level or nested)."""
+
+    module: "ModuleInfo"
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    qualname: str
+    is_jit_root: bool = False
+    static_argnames: Tuple[str, ...] = ()
+    jit_alias: str = ""  # name bound by `alias = jax.jit(func, ...)`
+
+    @property
+    def name(self) -> str:
+        return self.node.name  # type: ignore[attr-defined]
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    """Parsed module plus the name-resolution tables rules need."""
+
+    name: str  # dotted module name, e.g. open_simulator_tpu.ops.fast
+    path: str  # path as reported in findings (relative to repo root)
+    tree: ast.Module
+    lines: List[str]
+    # module-level defs by local name (includes jit-alias assignments)
+    functions: Dict[str, FunctionInfo] = dataclasses.field(default_factory=dict)
+    # local alias -> (dotted module, attr-or-None) for import/from-import
+    imports: Dict[str, Tuple[str, Optional[str]]] = dataclasses.field(default_factory=dict)
+    suppressions: Dict[int, Set[str]] = dataclasses.field(default_factory=dict)
+
+    def alias_for(self, dotted: str) -> Set[str]:
+        """Local names that refer to module ``dotted`` (e.g. {'jnp'} for
+        jax.numpy)."""
+        return {
+            local
+            for local, (mod, attr) in self.imports.items()
+            if attr is None and mod == dotted
+        }
+
+
+@dataclasses.dataclass
+class LintContext:
+    """Everything a rule gets: the package model + reachability results."""
+
+    modules: Dict[str, ModuleInfo]
+    # (module name, function qualname) -> representative jit root qualname
+    reachable: Dict[Tuple[str, str], str]
+    package: str
+
+    def jit_regions(self) -> Iterator[Tuple[ModuleInfo, ast.AST, str]]:
+        """Yield (module, function node, jit root qualname) for every
+        jit-reachable function body, nested defs excluded (they are part of
+        their parent's subtree and would double-report)."""
+        seen: Set[int] = set()
+        for (mod_name, qual), root in sorted(self.reachable.items()):
+            mod = self.modules[mod_name]
+            info = _find_function(mod, qual)
+            if info is None or id(info.node) in seen:
+                continue
+            # skip nested defs whose ancestor is also reachable
+            if any(
+                (mod_name, anc) in self.reachable
+                for anc in _ancestor_quals(qual)
+            ):
+                continue
+            seen.add(id(info.node))
+            yield mod, info.node, root
+
+    def resolve_call(
+        self, mod: ModuleInfo, func: ast.expr
+    ) -> Optional[Tuple[str, str]]:
+        """Resolve a Call.func expression to (module name, function qualname)
+        within the package, or None."""
+        if isinstance(func, ast.Name):
+            target = mod.functions.get(func.id)
+            if target is not None:
+                return mod.name, target.qualname
+            imp = mod.imports.get(func.id)
+            if imp is not None:
+                tmod, attr = imp
+                if attr is not None and tmod in self.modules:
+                    t = self.modules[tmod].functions.get(attr)
+                    if t is not None:
+                        return tmod, t.qualname
+        elif isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+            imp = mod.imports.get(func.value.id)
+            if imp is not None and imp[1] is None and imp[0] in self.modules:
+                t = self.modules[imp[0]].functions.get(func.attr)
+                if t is not None:
+                    return imp[0], t.qualname
+        return None
+
+
+@dataclasses.dataclass
+class LintReport:
+    findings: List[Finding]
+    files_scanned: int
+    rules: List[str]
+
+    @property
+    def active(self) -> List[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "version": 1,
+                "files_scanned": self.files_scanned,
+                "rules": self.rules,
+                "findings": [f.to_dict() for f in self.findings if not f.suppressed],
+                "suppressed": [f.to_dict() for f in self.findings if f.suppressed],
+            },
+            indent=2,
+            sort_keys=True,
+        )
+
+    def render_text(self) -> str:
+        out = [f.render() for f in self.active]
+        n_sup = sum(1 for f in self.findings if f.suppressed)
+        out.append(
+            f"simon lint: {len(self.active)} finding(s), {n_sup} suppressed, "
+            f"{self.files_scanned} file(s) scanned"
+        )
+        return "\n".join(out)
+
+
+# --------------------------------------------------------------------------
+# rule registry
+
+RuleFunc = Callable[[LintContext], Iterable[Finding]]
+_RULES: Dict[str, Tuple[str, RuleFunc]] = {}
+
+
+def rule(rule_id: str, doc: str) -> Callable[[RuleFunc], RuleFunc]:
+    """Register a rule. ``doc`` is the one-line catalogue entry."""
+
+    def deco(fn: RuleFunc) -> RuleFunc:
+        _RULES[rule_id] = (doc, fn)
+        return fn
+
+    return deco
+
+
+def iter_rules() -> List[Tuple[str, str]]:
+    """(rule-id, doc) pairs, sorted — the rule catalogue."""
+    _load_rules()
+    return sorted((rid, doc) for rid, (doc, _) in _RULES.items())
+
+
+_rules_loaded = False
+
+
+def _load_rules() -> None:
+    global _rules_loaded
+    if not _rules_loaded:
+        from . import rules as _rules_pkg  # noqa: F401  (registers via decorator)
+
+        _rules_loaded = True
+
+
+# --------------------------------------------------------------------------
+# package model construction
+
+
+def _module_name(pkg_root: str, py_path: str) -> str:
+    rel = os.path.relpath(py_path, os.path.dirname(pkg_root))
+    parts = rel[:-3].split(os.sep)  # strip .py
+    if parts[-1] == "__init__":
+        parts.pop()
+    return ".".join(parts)
+
+
+def _parse_suppressions(lines: List[str]) -> Dict[int, Set[str]]:
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(lines, start=1):
+        m = SUPPRESS_RE.search(line)
+        if m:
+            out[i] = {p.strip() for p in m.group(1).split(",")}
+    return out
+
+
+def _is_jax_jit(expr: ast.expr, mod: ModuleInfo) -> bool:
+    """True for expressions referring to jax.jit (via `import jax` or
+    `from jax import jit`)."""
+    if isinstance(expr, ast.Attribute) and expr.attr == "jit":
+        if isinstance(expr.value, ast.Name):
+            imp = mod.imports.get(expr.value.id)
+            return imp is not None and imp[0] == "jax" and imp[1] is None
+    if isinstance(expr, ast.Name):
+        imp = mod.imports.get(expr.id)
+        return imp == ("jax", "jit")
+    return False
+
+
+def _static_argnames_from_call(call: ast.Call) -> Tuple[str, ...]:
+    for kw in call.keywords:
+        if kw.arg in ("static_argnames", "static_argnums"):
+            v = kw.value
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return tuple(
+                    e.value
+                    for e in v.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                )
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                return (v.value,)
+    return ()
+
+
+def _scan_imports(tree: ast.Module, mod_name: str) -> Dict[str, Tuple[str, Optional[str]]]:
+    out: Dict[str, Tuple[str, Optional[str]]] = {}
+    pkg_parts = mod_name.split(".")
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                local = a.asname or a.name.split(".")[0]
+                # `import jax.numpy as jnp` binds jnp -> jax.numpy; plain
+                # `import jax.numpy` binds jax (the root) only.
+                out[local] = (a.name if a.asname else a.name.split(".")[0], None)
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                # relative import: resolve against this module's package
+                base = pkg_parts[: len(pkg_parts) - node.level]
+                target = ".".join(base + ([node.module] if node.module else []))
+            else:
+                target = node.module or ""
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                out[a.asname or a.name] = (target, a.name)
+    return out
+
+
+def _collect_functions(mod: ModuleInfo) -> None:
+    """Fill mod.functions (module-level defs + jit-alias assignments) and
+    mark jit roots anywhere in the module (nested defs included)."""
+
+    def visit(node: ast.AST, prefix: str, module_level: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                info = FunctionInfo(module=mod, node=child, qualname=qual)
+                for dec in child.decorator_list:
+                    if _is_jax_jit(dec, mod):
+                        info.is_jit_root = True
+                    elif isinstance(dec, ast.Call):
+                        # @jax.jit(...) or @functools.partial(jax.jit, ...)
+                        if _is_jax_jit(dec.func, mod):
+                            info.is_jit_root = True
+                            info.static_argnames = _static_argnames_from_call(dec)
+                        elif (
+                            isinstance(dec.func, ast.Attribute)
+                            and dec.func.attr == "partial"
+                            or isinstance(dec.func, ast.Name)
+                            and dec.func.id == "partial"
+                        ) and dec.args and _is_jax_jit(dec.args[0], mod):
+                            info.is_jit_root = True
+                            info.static_argnames = _static_argnames_from_call(dec)
+                if module_level:
+                    mod.functions[child.name] = info
+                else:
+                    mod.functions.setdefault(qual, info)
+                visit(child, f"{qual}.", False)
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.", False)
+            elif module_level and isinstance(child, ast.Assign):
+                # alias = jax.jit(func, static_argnames=...)
+                v = child.value
+                if (
+                    isinstance(v, ast.Call)
+                    and _is_jax_jit(v.func, mod)
+                    and v.args
+                    and isinstance(v.args[0], ast.Name)
+                ):
+                    target_name = v.args[0].id
+                    target = mod.functions.get(target_name)
+                    if target is not None:
+                        target.is_jit_root = True
+                        target.static_argnames = _static_argnames_from_call(v)
+                        for t in child.targets:
+                            if isinstance(t, ast.Name):
+                                target.jit_alias = t.id
+                                mod.functions.setdefault(t.id, target)
+            elif not isinstance(child, (ast.Lambda, ast.expr)):
+                # descend through if/for/while/try/with blocks so defs nested
+                # under control flow (e.g. jit closures built behind a cache
+                # check) are still discovered; module_level is preserved for
+                # module-level `if` guards around jit-alias assignments
+                visit(child, prefix, module_level)
+
+    visit(mod.tree, "", True)
+
+
+def _find_function(mod: ModuleInfo, qualname: str) -> Optional[FunctionInfo]:
+    for info in mod.functions.values():
+        if info.qualname == qualname:
+            return info
+    return None
+
+
+def _ancestor_quals(qual: str) -> Iterator[str]:
+    parts = qual.split(".")
+    for i in range(1, len(parts)):
+        yield ".".join(parts[:i])
+
+
+def _called_functions(
+    ctx: LintContext, mod: ModuleInfo, body: ast.AST
+) -> Iterator[Tuple[str, str]]:
+    """(module, qualname) pairs for package functions called from ``body``,
+    including function-valued args to traced higher-order functions."""
+    for node in ast.walk(body):
+        if not isinstance(node, ast.Call):
+            continue
+        resolved = ctx.resolve_call(mod, node.func)
+        if resolved is not None:
+            yield resolved
+        # jax.lax.scan(step, ...), jax.vmap(fn), lax.cond(p, t, f, ...)
+        fn = node.func
+        hof = isinstance(fn, ast.Attribute) and fn.attr in _TRACED_HOFS
+        if hof:
+            for arg in node.args:
+                if isinstance(arg, (ast.Name, ast.Attribute)):
+                    r = ctx.resolve_call(mod, arg)
+                    if r is not None:
+                        yield r
+
+
+def _compute_reachability(ctx: LintContext) -> None:
+    work: List[Tuple[str, str, str]] = []  # (module, qualname, root)
+    for mod in ctx.modules.values():
+        seen_ids: Set[int] = set()
+        for info in mod.functions.values():
+            if info.is_jit_root and id(info.node) not in seen_ids:
+                seen_ids.add(id(info.node))
+                root = f"{mod.name}:{info.qualname}"
+                work.append((mod.name, info.qualname, root))
+    while work:
+        mod_name, qual, root = work.pop()
+        key = (mod_name, qual)
+        if key in ctx.reachable:
+            continue
+        ctx.reachable[key] = root
+        mod = ctx.modules[mod_name]
+        info = _find_function(mod, qual)
+        if info is None:
+            continue
+        for tmod, tqual in _called_functions(ctx, mod, info.node):
+            if (tmod, tqual) not in ctx.reachable:
+                work.append((tmod, tqual, root))
+
+
+# --------------------------------------------------------------------------
+# driver
+
+
+def build_context(
+    package_root: Optional[str] = None, report_root: Optional[str] = None
+) -> LintContext:
+    """Parse the package and compute jit reachability.
+
+    ``package_root`` is the directory of the top-level package (defaults to
+    the installed ``open_simulator_tpu``); ``report_root`` is what finding
+    paths are made relative to (defaults to the package's parent).
+    """
+    if package_root is None:
+        package_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    package_root = os.path.abspath(package_root)
+    if report_root is None:
+        report_root = os.path.dirname(package_root)
+    pkg_name = os.path.basename(package_root)
+
+    modules: Dict[str, ModuleInfo] = {}
+    for dirpath, dirnames, filenames in os.walk(package_root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fname)
+            with open(full, "r", encoding="utf-8") as fh:
+                src = fh.read()
+            tree = ast.parse(src, filename=full)
+            name = _module_name(package_root, full)
+            mod = ModuleInfo(
+                name=name,
+                path=os.path.relpath(full, report_root),
+                tree=tree,
+                lines=src.splitlines(),
+            )
+            mod.imports = _scan_imports(tree, name)
+            mod.suppressions = _parse_suppressions(mod.lines)
+            modules[name] = mod
+    for mod in modules.values():
+        _collect_functions(mod)
+    ctx = LintContext(modules=modules, reachable={}, package=pkg_name)
+    _compute_reachability(ctx)
+    return ctx
+
+
+def run_lint(
+    package_root: Optional[str] = None,
+    report_root: Optional[str] = None,
+    only_rules: Optional[Iterable[str]] = None,
+) -> LintReport:
+    """Run every registered rule; suppression comments are honoured here so
+    rules stay oblivious to them."""
+    _load_rules()
+    ctx = build_context(package_root, report_root)
+    wanted = set(only_rules) if only_rules else None
+    findings: List[Finding] = []
+    for rid, (_doc, fn) in sorted(_RULES.items()):
+        if wanted is not None and rid not in wanted:
+            continue
+        for f in fn(ctx):
+            mod = _module_by_path(ctx, f.path)
+            if mod is not None:
+                sup = mod.suppressions.get(f.line, set())
+                if f.rule in sup:
+                    f.suppressed = True
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return LintReport(
+        findings=findings,
+        files_scanned=len(ctx.modules),
+        rules=[rid for rid in sorted(_RULES) if wanted is None or rid in wanted],
+    )
+
+
+def _module_by_path(ctx: LintContext, path: str) -> Optional[ModuleInfo]:
+    for mod in ctx.modules.values():
+        if mod.path == path:
+            return mod
+    return None

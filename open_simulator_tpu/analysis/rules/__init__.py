@@ -1,0 +1,11 @@
+"""Lint rules. Importing this package registers every rule with the
+engine's registry (``analysis.lint.rule``). Each module groups rules by
+the contract they guard:
+
+* ``purity``       — no tracer coercions or host-state reads in jit code
+* ``jit_contracts`` — static_argnames hashability, import-time jnp work
+* ``dtype``        — f32/i32 regime in ``ops/``
+* ``shapes``       — jit-entry shape args flow through bucketing helpers
+"""
+
+from . import dtype, jit_contracts, purity, shapes  # noqa: F401

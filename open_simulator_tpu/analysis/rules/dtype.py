@@ -1,0 +1,70 @@
+"""Dtype regime rule: ``ops/`` stays f32/i32.
+
+The kernels keep every count and score exact in float32 below 2**24
+(``ops/fast.py``'s fold-order contract) and JAX_ENABLE_X64 is off, so a
+stray ``float64``/``int64`` dtype either silently downcasts (x64
+disabled: wrong intent survives review) or doubles HBM traffic and
+defeats TPU-native layouts (x64 enabled). Bare Python ``float``/``int``
+as a dtype means float64/int64 by numpy convention — same trap spelled
+differently.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..lint import Finding, LintContext, rule
+
+_WIDE = {"float64", "int64", "uint64", "complex128", "double", "longdouble"}
+
+
+@rule(
+    "f64-literal",
+    "float64/int64 dtypes (or bare float/int as dtype=) in ops/ break the "
+    "f32/i32 exactness regime",
+)
+def f64_literal(ctx: LintContext) -> Iterator[Finding]:
+    for mod in ctx.modules.values():
+        if ".ops." not in f".{mod.name}.":
+            continue
+        np_like = mod.alias_for("numpy") | mod.alias_for("jax.numpy")
+        for node in ast.walk(mod.tree):
+            # np.float64 / jnp.int64 / np.double attribute access
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr in _WIDE
+                and isinstance(node.value, ast.Name)
+                and node.value.id in np_like
+            ):
+                yield Finding(
+                    "f64-literal", mod.path, node.lineno, node.col_offset,
+                    f"{node.value.id}.{node.attr} in ops/ leaves the f32/i32 "
+                    "regime; use float32/int32",
+                )
+            # dtype=float / dtype=int keywords, and astype(float)
+            elif isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if (
+                        kw.arg == "dtype"
+                        and isinstance(kw.value, ast.Name)
+                        and kw.value.id in ("float", "int")
+                    ):
+                        yield Finding(
+                            "f64-literal", mod.path, kw.value.lineno, kw.value.col_offset,
+                            f"dtype={kw.value.id} means "
+                            f"{kw.value.id}64 by numpy convention; spell the "
+                            "32-bit dtype explicitly",
+                        )
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "astype"
+                    and node.args
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in ("float", "int")
+                ):
+                    yield Finding(
+                        "f64-literal", mod.path, node.lineno, node.col_offset,
+                        f"astype({node.args[0].id}) widens to 64-bit; spell "
+                        "the 32-bit dtype explicitly",
+                    )

@@ -1,0 +1,85 @@
+"""jit API contracts: hashable static defaults, no import-time tracing.
+
+``static_argnames`` values key the jit cache by ``__hash__``; a mutable
+default (list/dict/set) raises ``Unhashable`` the first time the default
+is actually used — often only on an uncommon code path. Module-level
+``jnp.`` calls run a trace + device transfer at import time, which both
+slows cold start and pins arrays to whatever backend happens to be
+default during import (breaking later ``JAX_PLATFORMS`` overrides).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..lint import Finding, LintContext, rule
+
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)
+
+
+@rule(
+    "unhashable-static-default",
+    "a static_argnames parameter with a list/dict/set default raises "
+    "TypeError: unhashable when the default is used as a jit cache key",
+)
+def unhashable_static_default(ctx: LintContext) -> Iterator[Finding]:
+    for mod in ctx.modules.values():
+        seen: set = set()
+        for info in mod.functions.values():
+            if not info.is_jit_root or not info.static_argnames or id(info.node) in seen:
+                continue
+            seen.add(id(info.node))
+            args = info.node.args  # type: ignore[attr-defined]
+            pos = args.posonlyargs + args.args
+            defaults = args.defaults
+            # defaults align with the tail of the positional parameters
+            for a, d in zip(pos[len(pos) - len(defaults):], defaults):
+                if a.arg in info.static_argnames and isinstance(d, _UNHASHABLE):
+                    yield Finding(
+                        "unhashable-static-default", mod.path, d.lineno, d.col_offset,
+                        f"static arg {a.arg!r} of {info.qualname} has an "
+                        "unhashable default; use a tuple/frozen value",
+                    )
+            for a, d in zip(args.kwonlyargs, args.kw_defaults):
+                if d is not None and a.arg in info.static_argnames and isinstance(d, _UNHASHABLE):
+                    yield Finding(
+                        "unhashable-static-default", mod.path, d.lineno, d.col_offset,
+                        f"static arg {a.arg!r} of {info.qualname} has an "
+                        "unhashable default; use a tuple/frozen value",
+                    )
+
+
+@rule(
+    "import-time-jnp",
+    "module-level jnp. computation traces and transfers at import time, "
+    "pinning arrays to the import-time backend",
+)
+def import_time_jnp(ctx: LintContext) -> Iterator[Finding]:
+    for mod in ctx.modules.values():
+        jnp_alias = mod.alias_for("jax.numpy")
+        if not jnp_alias:
+            continue
+
+        def walk_module_level(node: ast.AST) -> Iterator[ast.AST]:
+            """Statements executed at import: module body, class bodies, and
+            if/try/with blocks at those levels — but not function bodies."""
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                yield child
+                yield from walk_module_level(child)
+
+        for node in walk_module_level(mod.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in jnp_alias
+            ):
+                yield Finding(
+                    "import-time-jnp", mod.path, node.lineno, node.col_offset,
+                    f"jnp.{node.func.attr}() at module import time traces on "
+                    "the import-time backend; build constants inside the "
+                    "kernel or behind a cached function",
+                )

@@ -1,0 +1,176 @@
+"""Purity rules: traced code must not sync to host or read host state.
+
+A ``float(x)``/``int(x)``/``bool(x)``/``x.item()`` on a traced value
+raises ``ConcretizationTypeError`` at best and, under ``jnp.where``-style
+tracing, silently forces a device→host transfer at worst. ``np.asarray``
+on a tracer materialises it. Host-state reads (``time.*``,
+``os.environ``, ``random.*``) are baked in at trace time — the jitted
+kernel replays the first call's value forever, which is exactly the bug
+class the compile cache makes invisible.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from ..lint import Finding, LintContext, ModuleInfo, rule
+
+_COERCERS = {"float", "int", "bool", "complex"}
+#: numpy calls that materialise their argument (host transfer on tracers).
+_NP_MATERIALIZERS = {"asarray", "array", "copy", "frombuffer", "ascontiguousarray"}
+#: attribute names whose access yields static (Python-level) values even on
+#: traced arrays — coercing these is fine.
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype", "nbytes", "itemsize"}
+
+
+def _is_static_expr(node: ast.expr) -> bool:
+    """True when the expression is host-level for sure (safe to coerce)."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Name):
+        # module-level ALL_CAPS constants (J_CAP, DM_CAP, ...) are ints
+        return node.id.isupper() or node.id == "__debug__"
+    if isinstance(node, ast.Attribute):
+        return node.attr in _STATIC_ATTRS or _is_static_expr(node.value)
+    if isinstance(node, ast.Subscript):
+        return _is_static_expr(node.value)
+    if isinstance(node, ast.Call):
+        if isinstance(node.func, ast.Name) and node.func.id in ("len", "min", "max"):
+            return all(_is_static_expr(a) for a in node.args)
+        return False
+    if isinstance(node, ast.BinOp):
+        return _is_static_expr(node.left) and _is_static_expr(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _is_static_expr(node.operand)
+    if isinstance(node, ast.IfExp):
+        return _is_static_expr(node.body) and _is_static_expr(node.orelse)
+    return False
+
+
+def _attr_root(node: ast.expr) -> ast.expr:
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node
+
+
+def _np_aliases(mod: ModuleInfo) -> Set[str]:
+    return mod.alias_for("numpy")
+
+
+@rule(
+    "tracer-coercion",
+    "float()/int()/bool()/.item()/np.asarray on values inside jit-traced code "
+    "forces a host sync or ConcretizationTypeError",
+)
+def tracer_coercion(ctx: LintContext) -> Iterator[Finding]:
+    for mod, body, root in ctx.jit_regions():
+        np_alias = _np_aliases(mod)
+        for node in ast.walk(body):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            # float(x) / int(x) / bool(x) / complex(x)
+            if (
+                isinstance(fn, ast.Name)
+                and fn.id in _COERCERS
+                and fn.id not in mod.functions
+                and node.args
+                and not all(_is_static_expr(a) for a in node.args)
+            ):
+                yield Finding(
+                    "tracer-coercion", mod.path, node.lineno, node.col_offset,
+                    f"{fn.id}() on a potentially traced value concretizes the "
+                    "tracer (host sync); use jnp casts or hoist to host code",
+                    jit_root=root,
+                )
+            # x.item()
+            elif isinstance(fn, ast.Attribute) and fn.attr == "item" and not node.args:
+                yield Finding(
+                    "tracer-coercion", mod.path, node.lineno, node.col_offset,
+                    ".item() inside jit-traced code is a device->host "
+                    "transfer; keep the value on device",
+                    jit_root=root,
+                )
+            # np.asarray(x) and friends
+            elif (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in _NP_MATERIALIZERS
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in np_alias
+                and node.args
+                and not all(_is_static_expr(a) for a in node.args)
+            ):
+                yield Finding(
+                    "tracer-coercion", mod.path, node.lineno, node.col_offset,
+                    f"np.{fn.attr}() inside jit-traced code materializes the "
+                    "tracer on host; use jnp equivalents",
+                    jit_root=root,
+                )
+
+
+@rule(
+    "impure-read",
+    "time.*/os.environ/random.* reads inside jit-traced code are frozen at "
+    "trace time and silently replayed from the compile cache",
+)
+def impure_read(ctx: LintContext) -> Iterator[Finding]:
+    for mod, body, root in ctx.jit_regions():
+        time_alias = mod.alias_for("time")
+        os_alias = mod.alias_for("os")
+        random_alias = mod.alias_for("random")
+        np_alias = _np_aliases(mod)
+        for node in ast.walk(body):
+            if isinstance(node, ast.Attribute):
+                base = node.value
+                if (
+                    node.attr == "environ"
+                    and isinstance(base, ast.Name)
+                    and base.id in os_alias
+                ):
+                    yield Finding(
+                        "impure-read", mod.path, node.lineno, node.col_offset,
+                        "os.environ read inside jit-traced code is evaluated "
+                        "once at trace time; read it on host and pass a value",
+                        jit_root=root,
+                    )
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+                base_id = fn.value.id
+                if base_id in time_alias:
+                    yield Finding(
+                        "impure-read", mod.path, node.lineno, node.col_offset,
+                        f"time.{fn.attr}() inside jit-traced code is frozen "
+                        "at trace time",
+                        jit_root=root,
+                    )
+                elif base_id in random_alias:
+                    yield Finding(
+                        "impure-read", mod.path, node.lineno, node.col_offset,
+                        f"random.{fn.attr}() inside jit-traced code is frozen "
+                        "at trace time; use jax.random with explicit keys",
+                        jit_root=root,
+                    )
+                elif base_id in os_alias and fn.attr == "getenv":
+                    yield Finding(
+                        "impure-read", mod.path, node.lineno, node.col_offset,
+                        "os.getenv() inside jit-traced code is evaluated once "
+                        "at trace time",
+                        jit_root=root,
+                    )
+            # np.random.*() — stateful host RNG
+            if (
+                isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Attribute)
+                and fn.value.attr == "random"
+                and isinstance(fn.value.value, ast.Name)
+                and fn.value.value.id in np_alias
+            ):
+                yield Finding(
+                    "impure-read", mod.path, node.lineno, node.col_offset,
+                    f"np.random.{fn.attr}() inside jit-traced code is frozen "
+                    "at trace time; use jax.random with explicit keys",
+                    jit_root=root,
+                )

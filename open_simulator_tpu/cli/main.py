@@ -1,4 +1,4 @@
-"""simon CLI: apply / server / version / gen-doc.
+"""simon CLI: apply / server / lint / version / gen-doc.
 
 Parity: `/root/reference/cmd/` (cobra commands → argparse subcommands):
   apply   -f/--simon-config, --output-file, -i/--interactive, --use-greed,
@@ -59,6 +59,89 @@ def _add_apply(sub: argparse._SubParsersAction) -> None:
     )
 
 
+def _add_lint(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "lint",
+        help="static analysis: AST purity/shape/dtype rules + jaxpr audit",
+        description=(
+            "Run the static-analysis subsystem over the installed package: "
+            "the AST lint rules (tracer coercions, impure reads, dtype "
+            "drift, unbucketed jit shapes) and, unless --no-jaxpr, the "
+            "jaxpr auditor + recompile guard that trace the fast-path "
+            "kernels on canonical bucketed shapes. Exit 0 = clean. See "
+            "docs/static-analysis.md."
+        ),
+    )
+    p.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output format (json is the machine-readable CI artifact)",
+    )
+    p.add_argument(
+        "--rules", default="",
+        help="comma list of AST rule ids to run (default: all); "
+        "see `simon lint --list-rules`",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalogue and exit",
+    )
+    p.add_argument(
+        "--no-jaxpr", action="store_true",
+        help="skip the jaxpr auditor (pure-AST mode: no jax import, "
+        "suitable for pre-commit hooks)",
+    )
+    p.add_argument(
+        "--no-recompile-guard", action="store_true",
+        help="skip the capacity-sweep recompile guard (the slowest stage)",
+    )
+
+
+def _run_lint(args) -> int:
+    import json as _json
+
+    from ..analysis import iter_rules, run_lint
+
+    if args.list_rules:
+        for rid, doc in iter_rules():
+            print(f"{rid}: {doc}")
+        return 0
+    only = [r.strip() for r in args.rules.split(",") if r.strip()] or None
+    known = {rid for rid, _ in iter_rules()}
+    unknown = set(only or ()) - known
+    if unknown:
+        print(f"error: unknown rule(s) {sorted(unknown)}", file=sys.stderr)
+        return 1
+    report = run_lint(only_rules=only)
+    audit = guard = None
+    if not args.no_jaxpr:
+        from ..utils.platform import ensure_platform
+
+        ensure_platform()
+        from ..analysis.jaxpr_audit import run_audit, run_recompile_guard
+
+        audit = run_audit()
+        if not args.no_recompile_guard:
+            guard = run_recompile_guard()
+    ok = (
+        not report.active
+        and (audit is None or audit.ok)
+        and (guard is None or guard.ok)
+    )
+    if args.format == "json":
+        doc = _json.loads(report.to_json())
+        doc["jaxpr_audit"] = audit.to_dict() if audit is not None else None
+        doc["recompile_guard"] = guard.to_dict() if guard is not None else None
+        doc["ok"] = ok
+        print(_json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        print(report.render_text())
+        if audit is not None:
+            print(audit.render_text())
+        if guard is not None:
+            print(guard.render_text())
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     parser = argparse.ArgumentParser(
@@ -67,6 +150,7 @@ def main(argv=None) -> int:
     )
     sub = parser.add_subparsers(dest="command")
     _add_apply(sub)
+    _add_lint(sub)
     ps = sub.add_parser(
         "server", help="run the REST simulation service",
         description="run the REST simulation service",
@@ -102,6 +186,8 @@ def main(argv=None) -> int:
     if args.command == "version":
         print(f"simon-tpu version {VERSION}")
         return 0
+    if args.command == "lint":
+        return _run_lint(args)
     if args.command == "gen-doc":
         return _gen_doc(parser, args.output_dir)
     if args.command == "server":

@@ -552,7 +552,7 @@ def encode_pods(
 
     reps: List[Pod] = []
     rep_of: Dict[Tuple, int] = {}
-    inverse = np.empty(p, np.int64)
+    inverse = np.empty(p, np.int32)
     for i, pod in enumerate(pods):
         sig = _pod_row_sig(pod)
         j = rep_of.get(sig)

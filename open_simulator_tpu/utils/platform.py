@@ -71,3 +71,36 @@ def enable_compilation_cache() -> None:
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     except Exception:
         pass  # cache is an optimization — never fail an entry point over it
+
+
+_compile_listener_installed = False
+
+
+def install_compile_listener() -> bool:
+    """Mirror XLA backend compiles into the metrics registry.
+
+    Registers a jax.monitoring duration listener that bumps
+    ``osim_compile_cache_total{event="backend_compile"}`` every time XLA
+    actually compiles an executable (cache hits — in-process or persistent —
+    don't fire the event). One counter therefore tells the whole
+    compile-cache story: ``hit``/``miss`` from the engine's own jit lookup
+    caches, ``backend_compile`` from XLA itself; a recompile regression
+    shows up as the latter growing while the former stays flat. Idempotent;
+    returns False when jax.monitoring is unavailable."""
+    global _compile_listener_installed
+    if _compile_listener_installed:
+        return True
+    try:
+        from jax import monitoring
+    except ImportError:
+        return False
+
+    from . import metrics
+
+    def _on_event(event: str, duration: float, **kwargs) -> None:
+        if event == "/jax/core/compile/backend_compile_duration":
+            metrics.COMPILE_CACHE.inc(event="backend_compile")
+
+    monitoring.register_event_duration_secs_listener(_on_event)
+    _compile_listener_installed = True
+    return True
