@@ -96,6 +96,132 @@ def _add_lint(sub: argparse._SubParsersAction) -> None:
     )
 
 
+def _add_chaos(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "chaos",
+        help="run an apply under a deterministic fault plan and report "
+        "degraded vs failed behavior",
+        description=(
+            "Install a fault-injection plan (docs/resilience.md), run the "
+            "same simulation as `simon apply`, and print a deterministic "
+            "report: which faults fired, what degraded (retries, skipped "
+            "ignorable extenders, stale snapshots, failed app renders), and "
+            "what failed outright (unscheduled pods, aborted runs). The "
+            "report is byte-identical across runs with the same plan seed. "
+            "Exit 0 when the simulation completed — even degraded; 1 when "
+            "it aborted."
+        ),
+    )
+    p.add_argument("-f", "--simon-config", required=True, help="path of simon config")
+    p.add_argument(
+        "--fault-plan", default="",
+        help="fault plan YAML path (default: the OSIM_FAULT_PLAN env var)",
+    )
+    p.add_argument(
+        "--default-scheduler-config", default="",
+        help="KubeSchedulerConfiguration YAML merged with simon's plugin set",
+    )
+
+
+def _run_chaos(args) -> int:
+    import io as _io
+
+    from ..api.config import SimonConfig
+    from ..engine.apply import ApplyError, run_apply
+    from ..resilience import faults
+    from ..resilience.policy import breaker_states, reset_breakers
+    from ..utils import metrics
+
+    try:
+        plan = (
+            faults.FaultPlan.load(args.fault_plan)
+            if args.fault_plan
+            else faults.FaultPlan.from_env()
+        )
+    except faults.FaultInjectionError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if plan is None:
+        print(
+            "error: no fault plan (pass --fault-plan or set OSIM_FAULT_PLAN)",
+            file=sys.stderr,
+        )
+        return 1
+
+    # A clean slate makes the report a pure function of (config, plan seed):
+    # same seed in -> byte-identical report out.
+    metrics.REGISTRY.reset()
+    reset_breakers()
+    injector = faults.install_plan(plan)
+    aborted = ""
+    outcome = None
+    try:
+        cfg = SimonConfig.load(args.simon_config)
+        outcome = run_apply(
+            cfg,
+            out=_io.StringIO(),  # the chaos report replaces the apply report
+            scheduler_config=args.default_scheduler_config,
+        )
+    except (ApplyError, ValueError, OSError) as e:
+        aborted = str(e)
+    finally:
+        faults.uninstall_plan()
+
+    def total(counter) -> int:
+        snap = counter.snapshot()
+        return int(sum(s["value"] for s in snap["samples"]))
+
+    lines = ["simon chaos report", "=================="]
+    lines.append(f"fault plan: seed={plan.seed}, {len(plan.rules)} rule(s)")
+    for i, r in enumerate(injector.summary(), 1):
+        lines.append(
+            f"  rule {i}: target={r['target']} op={r['op'] or '*'} "
+            f"kind={r['kind']} -> injected {r['injected']} of "
+            f"{r['matched']} matched call(s)"
+        )
+    if aborted:
+        lines.append(f"outcome: failed — apply aborted: {aborted}")
+        print("\n".join(lines))
+        return 1
+
+    retries = total(metrics.RETRY_ATTEMPTS)
+    skips = total(metrics.EXTENDER_SKIPPED)
+    stale = total(metrics.SNAPSHOT_STALE)
+    failed_apps = sorted(fa.name for fa in outcome.failed_apps)
+    not_closed = sorted(
+        ep for ep, state in breaker_states().items() if state != "closed"
+    )
+    unscheduled = outcome.result.unscheduled
+    degraded = bool(retries or skips or stale or failed_apps or not_closed)
+
+    lines.append("degraded:")
+    lines.append(
+        "  apps failed to render: "
+        + (f"{len(failed_apps)} ({', '.join(failed_apps)})" if failed_apps else "0")
+    )
+    lines.append(f"  retries performed: {retries}")
+    lines.append(f"  ignorable extenders skipped: {skips}")
+    lines.append(f"  stale snapshots served: {stale}")
+    lines.append(
+        "  circuit breakers not closed: "
+        + (", ".join(not_closed) if not_closed else "none")
+    )
+    lines.append("failed:")
+    lines.append(f"  unscheduled pods: {len(unscheduled)}")
+    for reason in sorted({u.reason for u in unscheduled}):
+        lines.append(f"    reason: {reason}")
+    if unscheduled:
+        lines.append(
+            "outcome: failed — pods went unscheduled under the fault plan"
+        )
+    elif degraded:
+        lines.append("outcome: degraded — simulation completed under faults")
+    else:
+        lines.append("outcome: clean — no degradation observed")
+    print("\n".join(lines))
+    return 0
+
+
 def _run_lint(args) -> int:
     import json as _json
 
@@ -150,6 +276,7 @@ def main(argv=None) -> int:
     )
     sub = parser.add_subparsers(dest="command")
     _add_apply(sub)
+    _add_chaos(sub)
     _add_lint(sub)
     ps = sub.add_parser(
         "server", help="run the REST simulation service",
@@ -176,7 +303,7 @@ def main(argv=None) -> int:
     pd.add_argument("--output-dir", default="./docs/commandline")
 
     args = parser.parse_args(argv)
-    if args.command in ("apply", "server"):
+    if args.command in ("apply", "chaos", "server"):
         from ..utils.platform import enable_compilation_cache, ensure_platform
         from ..utils.tracing import init_logging
 
@@ -186,6 +313,8 @@ def main(argv=None) -> int:
     if args.command == "version":
         print(f"simon-tpu version {VERSION}")
         return 0
+    if args.command == "chaos":
+        return _run_chaos(args)
     if args.command == "lint":
         return _run_lint(args)
     if args.command == "gen-doc":

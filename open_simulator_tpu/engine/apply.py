@@ -67,6 +67,11 @@ def render_chart(path: str, name: str) -> List[dict]:
     try:
         return process_chart(path, release_name=name)
     except ChartError as e:
+        if "injected by fault plan" in str(e):
+            # chaos testing: a helm binary on the host must not quietly heal
+            # an injected rendering fault — the whole point is to exercise
+            # the degraded per-app failure path
+            raise ApplyError(f"app {name}: built-in chart renderer: {e}")
         helm = shutil.which("helm")
         if helm is None:
             raise ApplyError(
@@ -254,9 +259,14 @@ def run_apply(
             if plan is None:
                 print("capacity search failed: workload does not fit", file=out)
             else:
+                degraded = (
+                    f", {plan.retries} retried on transient extender errors"
+                    if plan.retries
+                    else ""
+                )
                 print(
                     f"capacity plan: add {plan.nodes_added} x {new_node.name} "
-                    f"({plan.attempts} simulations)",
+                    f"({plan.attempts} simulations{degraded})",
                     file=out,
                 )
                 result = plan.result

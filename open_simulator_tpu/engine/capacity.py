@@ -85,6 +85,19 @@ class CapacityPlan:
     nodes_added: int
     result: SimulateResult
     attempts: int
+    # probes re-run because a transient extender failure (not a scheduling
+    # verdict) left pods unscheduled — nonzero means the search ran degraded
+    retries: int = 0
+
+
+class _TransientTrialError(Exception):
+    """A capacity probe left pods unscheduled because of a transient extender
+    failure (UnscheduledPod.transient), not a scheduling verdict. Carries the
+    result so an exhausted retry can still return it honestly."""
+
+    def __init__(self, result: SimulateResult, reason: str) -> None:
+        super().__init__(reason)
+        self.result = result
 
 
 def _probe(
@@ -151,24 +164,62 @@ def plan_capacity(
     gates pass. Returns None if even max_new_nodes doesn't suffice."""
 
     from ..ops.encode import round_up
+    from ..resilience.policy import RetryExhaustedError, RetryPolicy
+    from ..utils.tracing import log
 
     attempts = 0
+    retries = 0
     n_base = len(cluster.nodes)
     # Workload expansion/validation is node-independent for everything but
     # DaemonSets — one shared cache expands the 100k-pod workload once for
     # the whole search instead of once per probe.
     expand_cache: dict = {}
+    # A trial whose pods failed on a transient extender error (a blip, not a
+    # verdict) is re-run once before its node count is trusted: buying nodes
+    # for a transport timeout would mis-size the cluster.
+    trial_policy = RetryPolicy.from_env(max_attempts=2)
 
     def good(res: SimulateResult) -> bool:
         return not res.unscheduled and satisfy_resource_setting(res)
 
-    base = _probe(cluster, apps, new_node, 0, weights, use_greed, mesh,
-                  profiles=profiles, expand_cache=expand_cache,
-                  extenders=extenders)
-    attempts += 1
+    def probe(k: int, n_pad: Optional[int] = None) -> SimulateResult:
+        nonlocal attempts, retries
+
+        def once(_timeout: Optional[float]) -> SimulateResult:
+            nonlocal attempts
+            attempts += 1
+            res = _probe(
+                cluster, apps, new_node, k, weights, use_greed, mesh,
+                n_pad=n_pad, profiles=profiles, expand_cache=expand_cache,
+                extenders=extenders,
+            )
+            blips = [u for u in res.unscheduled if u.transient]
+            if blips:
+                raise _TransientTrialError(res, blips[0].reason)
+            return res
+
+        def note(_attempt: int, exc: BaseException, _delay: float) -> None:
+            nonlocal retries
+            retries += 1
+            log.warning(
+                "capacity probe (%d nodes) hit a transient extender failure "
+                "(%s); retrying trial", k, exc,
+            )
+
+        try:
+            return trial_policy.execute(
+                once, retryable=(_TransientTrialError,),
+                target="capacity-probe", on_retry=note,
+            )
+        except RetryExhaustedError as e:
+            # the retry blipped too — return the degraded result honestly
+            # (its unscheduled list carries the extender error as the reason)
+            return e.last_exc.result  # type: ignore[union-attr]
+
+    base = probe(0)
     if good(base):
         metrics.CAPACITY_NODES_ADDED.set(0)
-        return CapacityPlan(0, base, attempts)
+        return CapacityPlan(0, base, attempts, retries)
 
     # Exponential growth to bracket, seeded by the demand/supply estimate
     # (skips most low probes), then bisect over the FULL [0, hi] range —
@@ -183,11 +234,7 @@ def plan_capacity(
         # (exponential probes rely on encode_nodes' default round_up(n, 64)
         # padding; only the bisection below needs an explicit pin, so every
         # mid-probe shares the bracket's bucket)
-        hi_result = _probe(
-            cluster, apps, new_node, hi, weights, use_greed, mesh,
-            profiles=profiles, expand_cache=expand_cache, extenders=extenders,
-        )
-        attempts += 1
+        hi_result = probe(hi)
         if good(hi_result):
             break
         lo = hi  # a failed probe IS a verified lower bound
@@ -199,12 +246,7 @@ def plan_capacity(
     n_pad = round_up(n_base + hi, 64)
     while lo + 1 < hi:
         mid = (lo + hi) // 2
-        res = _probe(
-            cluster, apps, new_node, mid, weights, use_greed, mesh,
-            n_pad=n_pad, profiles=profiles, expand_cache=expand_cache,
-            extenders=extenders,
-        )
-        attempts += 1
+        res = probe(mid, n_pad=n_pad)
         last_result = res
         if good(res):
             hi, best, best_result = mid, mid, res
@@ -215,12 +257,7 @@ def plan_capacity(
         # an earlier probe's result no longer reflects its own placements.
         # Replay the winning count once so the returned result's pods carry
         # their true bindings (same executables, so this is one cheap run).
-        best_result = _probe(
-            cluster, apps, new_node, best, weights, use_greed, mesh,
-            n_pad=n_pad, profiles=profiles, expand_cache=expand_cache,
-            extenders=extenders,
-        )
-        attempts += 1
+        best_result = probe(best, n_pad=n_pad)
         # The replay's correctness rests on run-to-run determinism of
         # simulate (e.g. DaemonSet pods re-expand with fresh RNG-suffixed
         # names, which must never influence placement). One cheap re-check
@@ -232,8 +269,6 @@ def plan_capacity(
         # unscheduled pods.
         if not good(best_result):
             if extenders:
-                from ..utils.tracing import log
-
                 log.warning(
                     "capacity replay of the winning probe (%d nodes) no "
                     "longer satisfies the plan — an extender answered "
@@ -247,4 +282,4 @@ def plan_capacity(
                     "nondeterministic"
                 )
     metrics.CAPACITY_NODES_ADDED.set(best)
-    return CapacityPlan(best, best_result, attempts)
+    return CapacityPlan(best, best_result, attempts, retries)

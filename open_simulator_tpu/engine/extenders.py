@@ -32,16 +32,41 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..core.objects import Node, Pod
 from ..models.profiles import ExtenderConfig
+from ..resilience import faults
+from ..resilience.policy import RetryExhaustedError, RetryPolicy, breaker_for
 from ..utils import metrics
 from ..utils.tracing import log
 
 # framework.MaxNodeScore / extenderv1.MaxExtenderPriority (100 / 10)
 EXTENDER_SCORE_SCALE = 10.0
 
+# Response-body bytes quoted in error messages (real extenders put the actual
+# failure reason in the body; unbounded quoting would bloat pod reasons).
+ERROR_BODY_SNIPPET_BYTES = 200
+
 
 class ExtenderError(Exception):
     """A non-ignorable extender failed; the pod being scheduled fails with
     this message (the reference aborts Schedule() with the error)."""
+
+
+class TransientExtenderError(ExtenderError):
+    """An extender failure worth retrying: connection/timeout errors, HTTP
+    5xx, or a malformed (possibly truncated) JSON body. Subclasses
+    ExtenderError so an exhausted retry degrades exactly like before."""
+
+
+def _http_error_detail(e: urllib.error.HTTPError) -> str:
+    """Status line + a bounded body snippet. urlopen raises HTTPError on any
+    non-2xx, so this — not a dead `resp.status != 200` branch — is where
+    extender-side failure text (carried in the body) must be captured."""
+    try:
+        body = e.read(ERROR_BODY_SNIPPET_BYTES + 1)
+    except Exception:
+        body = b""
+    snippet = body[:ERROR_BODY_SNIPPET_BYTES].decode("utf-8", "replace").strip()
+    detail = f"HTTP {e.code} {e.reason}"
+    return f"{detail}: {snippet}" if snippet else detail
 
 
 def _pod_json(pod: Pod) -> dict:
@@ -105,13 +130,20 @@ def _node_json(node: Node) -> dict:
 class HTTPExtender:
     """One configured extender endpoint (extender.go:93-123)."""
 
-    def __init__(self, cfg: ExtenderConfig):
+    def __init__(
+        self, cfg: ExtenderConfig, policy: Optional[RetryPolicy] = None
+    ):
         self.cfg = cfg
         base = cfg.url_prefix.rstrip("/")
         if cfg.enable_https and base.startswith("http://"):
             base = "https://" + base[len("http://"):]
         self.base = base
         self.managed = frozenset(r for r in cfg.managed_resources if r)
+        # Retries cover the idempotent filter/prioritize verbs only; the
+        # breaker registry is endpoint-keyed and shared process-wide so its
+        # state survives the per-simulate() rebuild of HTTPExtender objects.
+        self.policy = policy if policy is not None else RetryPolicy.from_env()
+        self.breaker = breaker_for(self.base)
 
     # -- extender.go:440-468 ------------------------------------------------
     def is_interested(self, pod: Pod) -> bool:
@@ -129,34 +161,77 @@ class HTTPExtender:
     def is_ignorable(self) -> bool:
         return self.cfg.ignorable
 
-    def _send(self, verb: str, args: dict) -> dict:
+    def _roundtrip(self, url: str, verb: str, data: bytes,
+                   timeout: Optional[float]) -> dict:
+        """One HTTP attempt. Transient failures (connection/timeout, HTTP
+        5xx, malformed JSON) raise TransientExtenderError; everything else
+        raises plain ExtenderError and is never retried."""
+        rule = faults.maybe_inject("extender", verb)
+        body: Optional[bytes] = None
+        try:
+            if rule is not None:
+                body = faults.apply_http_fault(rule, url)
+            if body is None:
+                req = urllib.request.Request(
+                    url, data=data,
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                # http_timeout_s == 0 means no client timeout (Go zero
+                # Timeout); a retry policy deadline may tighten it further
+                eff = timeout
+                if self.cfg.http_timeout_s:
+                    eff = (
+                        self.cfg.http_timeout_s
+                        if eff is None
+                        else min(eff, self.cfg.http_timeout_s)
+                    )
+                with urllib.request.urlopen(req, timeout=eff) as resp:
+                    body = resp.read()
+        except urllib.error.HTTPError as e:
+            detail = _http_error_detail(e)
+            cls = TransientExtenderError if e.code >= 500 else ExtenderError
+            raise cls(f"extender {url}: {detail}")
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            raise TransientExtenderError(f"extender {url}: {e}")
+        try:
+            return json.loads(body) or {}
+        except ValueError as e:
+            # truncated/garbled payloads are transport-level and transient
+            raise TransientExtenderError(
+                f"extender {url}: invalid JSON response: {e}"
+            )
+
+    def _send(self, verb: str, args: dict, retry: bool = True) -> dict:
         url = f"{self.base}/{verb}"
         data = json.dumps(args).encode()
-        req = urllib.request.Request(
-            url, data=data, headers={"Content-Type": "application/json"},
-            method="POST",
-        )
         t0 = time.monotonic()
         try:
-            try:
-                # http_timeout_s == 0 means no client timeout (Go zero
-                # Timeout)
-                with urllib.request.urlopen(
-                    req, timeout=self.cfg.http_timeout_s or None
-                ) as resp:
-                    body = resp.read()
-                    if resp.status != 200:
-                        raise ExtenderError(
-                            f"extender {url}: HTTP {resp.status}"
-                        )
-            except (urllib.error.URLError, OSError, TimeoutError) as e:
-                raise ExtenderError(f"extender {url}: {e}")
-            try:
-                out = json.loads(body) or {}
-            except ValueError as e:
-                raise ExtenderError(
-                    f"extender {url}: invalid JSON response: {e}"
+            if not self.breaker.allow():
+                metrics.EXTENDER_REQUESTS.inc(
+                    verb=verb, outcome="circuit_open"
                 )
+                raise ExtenderError(
+                    f"extender {url}: {self.breaker.describe()}; failing fast"
+                )
+            try:
+                if retry:
+                    out = self.policy.execute(
+                        lambda t: self._roundtrip(url, verb, data, t),
+                        retryable=(TransientExtenderError,),
+                        target="extender",
+                    )
+                else:
+                    out = self._roundtrip(url, verb, data, None)
+            except RetryExhaustedError as e:
+                self.breaker.record_failure(str(e.last_exc))
+                # stays Transient: the capacity planner re-runs trials that
+                # failed this way rather than buying nodes for a blip
+                raise TransientExtenderError(str(e))
+            except ExtenderError as e:
+                self.breaker.record_failure(str(e))
+                raise
+            self.breaker.record_success()
         except ExtenderError:
             metrics.EXTENDER_REQUESTS.inc(verb=verb, outcome="error")
             raise
@@ -264,7 +339,10 @@ class HTTPExtender:
                 }
                 for node, (victims, n_viol) in victims_map.items()
             }
-        result = self._send(self.cfg.preempt_verb, args)
+        # ProcessPreemption is NOT retried: the verb mutates extender-side
+        # victim bookkeeping in real deployments, so only the idempotent
+        # filter/prioritize verbs ride the retry policy.
+        result = self._send(self.cfg.preempt_verb, args, retry=False)
         # The extender always returns NodeNameToMetaVictims (extender.go:195)
         out: Dict[str, Tuple[List[Pod], int]] = {}
         for node, meta in (result.get("NodeNameToMetaVictims") or {}).items():

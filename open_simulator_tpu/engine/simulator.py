@@ -105,6 +105,10 @@ class AppResource:
 class UnscheduledPod:
     pod: Pod
     reason: str
+    # True when the failure is a transient external-I/O error (exhausted
+    # extender retries) rather than a scheduling verdict — the capacity
+    # planner retries such trials instead of buying nodes for a blip
+    transient: bool = False
 
 
 @dataclass
@@ -521,7 +525,11 @@ class Simulator:
         from ..ops.kernels import commit_step, probe_step
         from ..ops.state import pod_rows_from_batch_host
         from ..utils.tracing import log
-        from .extenders import EXTENDER_SCORE_SCALE, ExtenderError
+        from .extenders import (
+            EXTENDER_SCORE_SCALE,
+            ExtenderError,
+            TransientExtenderError,
+        )
 
         with span("encode", pods=len(pods)):
             batch = encode_pods(self.enc, pods)
@@ -549,6 +557,7 @@ class Simulator:
                 n_device_feasible = len(feasible)
                 ext_msgs: Dict[str, str] = {}   # node -> extender failure msg
                 error: Optional[str] = None
+                error_transient = False
                 for ext in self._extenders:
                     if not feasible:
                         break
@@ -558,16 +567,22 @@ class Simulator:
                         feasible, failed_map = ext.filter(pod, feasible)
                     except ExtenderError as e:
                         if ext.is_ignorable:
+                            # degraded mode: an erroring (or circuit-open)
+                            # ignorable extender is skipped, not fatal
+                            metrics.EXTENDER_SKIPPED.inc(endpoint=ext.base)
                             log.warning(
                                 "skipping ignorable extender: %s", e
                             )
                             continue
                         error = str(e)
+                        error_transient = isinstance(e, TransientExtenderError)
                         break
                     for name, msg in failed_map.items():
                         ext_msgs.setdefault(name, msg)
                 if error is not None:
-                    failed.append(UnscheduledPod(pod, error))
+                    failed.append(
+                        UnscheduledPod(pod, error, transient=error_transient)
+                    )
                     continue
                 if not feasible:
                     failed.append(
@@ -591,6 +606,7 @@ class Simulator:
                     except ExtenderError as e:
                         # prioritize errors are ignored (generic_scheduler.go
                         # :529-536 logs and drops them)
+                        metrics.EXTENDER_SKIPPED.inc(endpoint=ext.base)
                         log.warning("extender prioritize failed: %s", e)
                 # lowest-node-index tie-break, matching the scan's argmax
                 name_index = self._name_index_map()

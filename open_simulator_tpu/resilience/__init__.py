@@ -1,0 +1,48 @@
+"""Resilience layer: retry/backoff policies, circuit breakers, and
+deterministic fault injection for every external-I/O path (scheduler
+extenders, the apiserver client, chart rendering, the REST server).
+
+See docs/resilience.md for the operator-facing knobs and the fault-plan
+YAML schema; `simon chaos` runs an apply under a plan and reports what
+degraded vs. what failed.
+"""
+
+from .faults import (
+    FaultInjectionError,
+    FaultInjector,
+    FaultPlan,
+    FaultRule,
+    active_injector,
+    injected,
+    install_plan,
+    maybe_inject,
+    uninstall_plan,
+)
+from .policy import (
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryExhaustedError,
+    RetryPolicy,
+    breaker_for,
+    breaker_states,
+    reset_breakers,
+)
+
+__all__ = [
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "FaultInjectionError",
+    "FaultInjector",
+    "FaultPlan",
+    "FaultRule",
+    "RetryExhaustedError",
+    "RetryPolicy",
+    "active_injector",
+    "breaker_for",
+    "breaker_states",
+    "injected",
+    "install_plan",
+    "maybe_inject",
+    "reset_breakers",
+    "uninstall_plan",
+]

@@ -23,6 +23,8 @@ cluster per request (CreateClusterResourceFromClient parity). Request schema:
 from __future__ import annotations
 
 import json
+import os
+import signal
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -53,6 +55,29 @@ _snapshot: Optional[ClusterResource] = None
 _snapshot_at = 0.0
 _snapshot_fetches = 0  # observability + test hook
 
+# Per-connection socket read timeout: a slow-loris client trickling a request
+# body would otherwise pin a handler thread — and, on POST, the _busy lock's
+# 503 semantics — forever. Body reads that exceed it return 408.
+REQUEST_TIMEOUT_S = float(os.environ.get("OSIM_SERVER_REQUEST_TIMEOUT_S", "30"))
+
+# serve()'s active server, so the SIGTERM/SIGINT handler (and tests) can
+# trigger a graceful drain from outside the serve_forever loop.
+_current_server: Optional[ThreadingHTTPServer] = None
+
+
+class _DrainingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer whose server_close() actually drains.
+
+    socketserver only tracks non-daemon handler threads for the
+    block_on_close join, and ThreadingHTTPServer marks handlers daemonic —
+    so a plain server_close() would drop in-flight requests on the floor.
+    Non-daemon handlers make the close a real drain: every request already
+    being computed completes and its response is sent before the process
+    exits. The per-socket REQUEST_TIMEOUT_S bounds how long a wedged or idle
+    keep-alive client can stall that drain."""
+
+    daemon_threads = False
+
 
 def _live_snapshot() -> ClusterResource:
     """Cached kubeconfig/master-backed cluster snapshot. Returns a fresh
@@ -65,13 +90,32 @@ def _live_snapshot() -> ClusterResource:
     global _snapshot, _snapshot_at, _snapshot_fetches
     now = time.monotonic()
     if _snapshot is None or now - _snapshot_at > _resync_s:
-        from ..utils.kubeclient import create_cluster_resource_from_kubeconfig
-
-        _snapshot = create_cluster_resource_from_kubeconfig(
-            _kubeconfig or "", master=_master
+        from ..utils.kubeclient import (
+            KubeClientError,
+            create_cluster_resource_from_kubeconfig,
         )
-        _snapshot_at = now
-        _snapshot_fetches += 1
+
+        try:
+            _snapshot = create_cluster_resource_from_kubeconfig(
+                _kubeconfig or "", master=_master
+            )
+            _snapshot_at = now
+            _snapshot_fetches += 1
+        except KubeClientError as e:
+            if _snapshot is None:
+                raise  # nothing cached to degrade to
+            # Graceful degradation: a failed refresh serves the stale cached
+            # snapshot instead of failing the request (the reference's
+            # informer cache behaves the same way when the apiserver flaps).
+            # _snapshot_at is left unchanged so the next request retries the
+            # refresh immediately.
+            from ..utils.tracing import log
+
+            metrics.SNAPSHOT_STALE.inc()
+            log.warning(
+                "cluster snapshot refresh failed (%s); serving stale "
+                "snapshot (age %.0fs)", e, now - _snapshot_at,
+            )
     c = _snapshot
     return ClusterResource(
         nodes=list(c.nodes),
@@ -257,6 +301,12 @@ def _heap_profile() -> dict:
 
 
 class _Handler(BaseHTTPRequestHandler):
+    def setup(self):
+        # BaseRequestHandler applies self.timeout to the connection socket;
+        # read dynamically so tests / serve() can tune it per server
+        self.timeout = REQUEST_TIMEOUT_S
+        super().setup()
+
     def _count(self, code: int) -> None:
         from urllib.parse import urlparse
 
@@ -351,8 +401,17 @@ class _Handler(BaseHTTPRequestHandler):
         # that race and bounces it with a spurious 503.
         try:
             length = int(self.headers.get("Content-Length", 0))
-            body = json.loads(self.rfile.read(length) or b"{}")
-            code, payload = 200, _simulate_request(body)
+            try:
+                raw = self.rfile.read(length)
+            except TimeoutError:
+                # slow-loris: the client sent headers but trickles (or never
+                # sends) the body; the socket timeout frees this thread — and
+                # the _busy lock — bounded by REQUEST_TIMEOUT_S
+                self.close_connection = True
+                code, payload = 408, {"error": "request body read timed out"}
+            else:
+                body = json.loads(raw or b"{}")
+                code, payload = 200, _simulate_request(body)
         except Exception as e:  # surface simulation errors as 400s
             code, payload = 400, {"error": str(e)}
         finally:
@@ -363,19 +422,46 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
 
+def _graceful_shutdown(signum=None, frame=None) -> None:
+    """SIGTERM/SIGINT handler: stop accepting connections and let serve()
+    fall through to its drain. shutdown() must not run on the thread inside
+    serve_forever (it deadlocks waiting for the loop to exit), so it is
+    dispatched to a helper thread; signal handlers always run on the main
+    thread, which IS the serve_forever thread."""
+    httpd = _current_server
+    if httpd is None:
+        return
+    name = signal.Signals(signum).name if signum is not None else "shutdown"
+    print(f"simon server: received {name}, draining in-flight requests")
+    threading.Thread(
+        target=httpd.shutdown, name="osim-shutdown", daemon=True
+    ).start()
+
+
 def serve(
     port: int = 9998,
     ready: Optional[threading.Event] = None,
     kubeconfig: str = "",
     master: str = "",
 ) -> int:
-    global _kubeconfig, _master, _snapshot, _snapshot_at
+    global _kubeconfig, _master, _snapshot, _snapshot_at, _current_server
     _kubeconfig = kubeconfig or None
     _master = master
     # a previous serve() in this process may have cached a snapshot of a
     # DIFFERENT cluster — never serve it against the new config
     _snapshot, _snapshot_at = None, 0.0
-    httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+    httpd = _DrainingHTTPServer(("127.0.0.1", port), _Handler)
+    _current_server = httpd
+    # Graceful termination: SIGTERM (kubelet/systemd stop) and SIGINT drain
+    # in-flight requests before exiting. signal.signal only works on the
+    # main thread — embedded/test serve() threads skip installation and can
+    # call _graceful_shutdown directly instead.
+    prior = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            prior[sig] = signal.signal(sig, _graceful_shutdown)
+        except ValueError:
+            break
     if ready is not None:
         ready.set()
     print(f"simon server listening on 127.0.0.1:{port}")
@@ -384,10 +470,15 @@ def serve(
     except KeyboardInterrupt:
         pass
     finally:
+        # server_close() joins every in-flight handler thread
+        # (_DrainingHTTPServer) — this IS the drain.
         httpd.server_close()
+        _current_server = None
+        for sig, handler in prior.items():
+            signal.signal(sig, handler)
     return 0
 
 
 def make_server(port: int = 0):
     """Embeddable server for tests; returns the ThreadingHTTPServer."""
-    return ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+    return _DrainingHTTPServer(("127.0.0.1", port), _Handler)

@@ -28,9 +28,18 @@ from typing import Any, Dict, List, Optional
 
 import yaml
 
+from ..resilience import faults
+from ..resilience.policy import RetryExhaustedError, RetryPolicy
+
 
 class KubeClientError(Exception):
     pass
+
+
+class TransientKubeError(KubeClientError):
+    """An apiserver failure worth retrying: connection/timeout errors, HTTP
+    5xx, or 429 Too Many Requests. Subclasses KubeClientError so exhausted
+    retries surface through the existing error path."""
 
 
 @dataclass
@@ -107,9 +116,22 @@ def load_kubeconfig(path: str, context: Optional[str] = None) -> KubeConfig:
 class KubeClient:
     """GET-only API client: list_* helpers returning decoded items."""
 
-    def __init__(self, cfg: KubeConfig, timeout: float = 30.0) -> None:
+    def __init__(
+        self,
+        cfg: KubeConfig,
+        timeout: float = 30.0,
+        policy: Optional[RetryPolicy] = None,
+    ) -> None:
         self.cfg = cfg
         self.timeout = timeout
+        # transient apiserver errors retry under an overall deadline so a
+        # snapshot against a flapping apiserver degrades gracefully instead
+        # of failing on the first blip (OSIM_RETRY_* env knobs apply)
+        self.policy = (
+            policy
+            if policy is not None
+            else RetryPolicy.from_env(deadline_s=60.0)
+        )
         if cfg.server.startswith("https"):
             if cfg.insecure:
                 ctx = ssl._create_unverified_context()
@@ -132,21 +154,51 @@ class KubeClient:
             cfg.server = master.rstrip("/")
         return KubeClient(cfg)
 
-    def get(self, api_path: str) -> Dict[str, Any]:
+    def _get_once(
+        self, api_path: str, timeout: Optional[float]
+    ) -> Dict[str, Any]:
         url = f"{self.cfg.server}{api_path}"
-        req = urllib.request.Request(url)
-        req.add_header("Accept", "application/json")
-        if self.cfg.token:
-            req.add_header("Authorization", f"Bearer {self.cfg.token}")
+        rule = faults.maybe_inject("kubeclient", api_path)
+        body: Optional[bytes] = None
         try:
-            with urllib.request.urlopen(
-                req, timeout=self.timeout, context=self._ssl
-            ) as resp:
-                return json.loads(resp.read())
+            if rule is not None:
+                body = faults.apply_http_fault(rule, url)
+            if body is None:
+                req = urllib.request.Request(url)
+                req.add_header("Accept", "application/json")
+                if self.cfg.token:
+                    req.add_header("Authorization", f"Bearer {self.cfg.token}")
+                eff = self.timeout if timeout is None else min(timeout, self.timeout)
+                with urllib.request.urlopen(
+                    req, timeout=eff, context=self._ssl
+                ) as resp:
+                    body = resp.read()
         except urllib.error.HTTPError as e:
-            raise KubeClientError(f"GET {api_path}: HTTP {e.code} {e.reason}")
-        except (urllib.error.URLError, OSError, ValueError) as e:
-            raise KubeClientError(f"GET {api_path}: {e}")
+            # 5xx and 429 (apiserver overload/flow-control) are transient;
+            # 4xx (bad auth, missing resource) will not heal with retries
+            cls = (
+                TransientKubeError
+                if e.code >= 500 or e.code == 429
+                else KubeClientError
+            )
+            raise cls(f"GET {api_path}: HTTP {e.code} {e.reason}")
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            raise TransientKubeError(f"GET {api_path}: {e}")
+        try:
+            return json.loads(body)
+        except ValueError as e:
+            # truncated/garbled payloads are transport-level and transient
+            raise TransientKubeError(f"GET {api_path}: {e}")
+
+    def get(self, api_path: str) -> Dict[str, Any]:
+        try:
+            return self.policy.execute(
+                lambda t: self._get_once(api_path, t),
+                retryable=(TransientKubeError,),
+                target="kubeclient",
+            )
+        except RetryExhaustedError as e:
+            raise KubeClientError(str(e))
 
     def list(self, api_path: str, kind: str) -> List[dict]:
         """List a resource; items get apiVersion/kind stamped back on (the

@@ -454,6 +454,31 @@ APPLY_RUNS = REGISTRY.counter(
     "simon-apply runs, by outcome.",
     labelnames=("outcome",),
 )
+RETRY_ATTEMPTS = REGISTRY.counter(
+    "osim_retry_attempts_total",
+    "Retries performed by resilience.RetryPolicy, by call target.",
+    labelnames=("target",),
+)
+CIRCUIT_STATE = REGISTRY.gauge(
+    "osim_circuit_state",
+    "Per-endpoint circuit-breaker state (0=closed, 1=open, 2=half-open).",
+    labelnames=("endpoint",),
+)
+EXTENDER_SKIPPED = REGISTRY.counter(
+    "osim_extender_skipped_total",
+    "Ignorable extenders skipped after an error or an open circuit breaker.",
+    labelnames=("endpoint",),
+)
+SNAPSHOT_STALE = REGISTRY.counter(
+    "osim_snapshot_stale_total",
+    "Server requests served from a stale cluster snapshot after a refresh "
+    "failure.",
+)
+FAULTS_INJECTED = REGISTRY.counter(
+    "osim_faults_injected_total",
+    "Faults injected by the resilience fault-injection harness.",
+    labelnames=("target", "kind"),
+)
 
 # Span names that map onto a dedicated kube-parity histogram; everything
 # else lands only in osim_span_duration_seconds{span=...}.

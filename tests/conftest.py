@@ -49,6 +49,9 @@ class _StubExtender:
     - scores: {node: int 0..10} returned by prioritize
     - error: string returned as ExtenderFilterResult.Error
     - http_error: int -> respond with that status code
+    - http_error_body: bytes sent as the http_error response body
+    - fail_first: int -> respond 503 to the first N requests, then behave
+      normally (flaky-then-recovers, for retry tests)
     - preempt_allow: set of node names kept in ProcessPreemption (None =
       keep all); victims echo back unchanged (as MetaVictims UIDs)
     - preempt_raw: full NodeNameToMetaVictims dict to return verbatim
@@ -68,9 +71,18 @@ class _StubExtender:
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
                 stub.calls.append((self.path, body))
-                if stub.behavior.get("http_error"):
-                    self.send_response(stub.behavior["http_error"])
+                fail_first = stub.behavior.get("fail_first", 0)
+                if fail_first and len(stub.calls) <= fail_first:
+                    self.send_response(503)
                     self.end_headers()
+                    return
+                if stub.behavior.get("http_error"):
+                    err_body = stub.behavior.get("http_error_body") or b""
+                    self.send_response(stub.behavior["http_error"])
+                    self.send_header("Content-Length", str(len(err_body)))
+                    self.end_headers()
+                    if err_body:
+                        self.wfile.write(err_body)
                     return
                 if self.path.endswith("/filter"):
                     names = body.get("NodeNames")
@@ -174,6 +186,21 @@ class _StubExtender:
     def close(self):
         self.server.shutdown()
         self.server.server_close()
+
+
+@pytest.fixture(autouse=True)
+def _reset_resilience():
+    """Breakers live in a process-wide endpoint-keyed registry and fault
+    plans install globally; clear both around every test so one test's
+    tripped breaker or leaked plan can't leak into the next."""
+    from open_simulator_tpu.resilience import faults
+    from open_simulator_tpu.resilience.policy import reset_breakers
+
+    reset_breakers()
+    faults.uninstall_plan()
+    yield
+    reset_breakers()
+    faults.uninstall_plan()
 
 
 @pytest.fixture
