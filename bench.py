@@ -567,9 +567,58 @@ def config_extender(n_pods=1_000, n_nodes=100):
         httpd.server_close()
 
 
+def config_sanitize_overhead(n_pods=1_000, n_nodes=100):
+    """Config 8: the OSIM_SANITIZE=1 checkify tax. The same
+    NodeResourcesFit sweep as fit_1k_100n, run plain and then sanitized in
+    one process — ops/sanitize.py reads the env var per dispatch, so the
+    flip needs no re-import. Each mode runs twice and reports its second,
+    warm wall (the sanitized mode compiles its own checkify-wrapped
+    executables on the first pass); overhead_x is warm-vs-warm."""
+    nodes = [_mk_node(f"n-{i}", "32", "64Gi") for i in range(n_nodes)]
+    deploys = [
+        _mk_deploy("web", n_pods // 2, "500m", "1Gi"),
+        _mk_deploy("api", n_pods - n_pods // 2, "1", "2Gi"),
+    ]
+
+    def run_mode(flag: str):
+        prev = os.environ.get("OSIM_SANITIZE")
+        os.environ["OSIM_SANITIZE"] = flag
+        try:
+            cold, _, _ = _simulate_config(nodes, deploys)
+            warm, placed, unsched = _simulate_config(nodes, deploys)
+        finally:
+            if prev is None:
+                os.environ.pop("OSIM_SANITIZE", None)
+            else:
+                os.environ["OSIM_SANITIZE"] = prev
+        return cold, warm, placed, unsched
+
+    p_cold, p_warm, p_placed, p_unsched = run_mode("0")
+    s_cold, s_warm, s_placed, s_unsched = run_mode("1")
+    out = {
+        "wall_s": round(s_warm, 2),
+        "value": round(n_pods / s_warm, 1),
+        "plain_wall_s": round(p_warm, 2),
+        "sanitized_wall_s": round(s_warm, 2),
+        "plain_cold_wall_s": round(p_cold, 2),
+        "sanitized_cold_wall_s": round(s_cold, 2),
+        "overhead_x": round(s_warm / p_warm, 2) if p_warm > 0 else None,
+        "scheduled": p_placed,
+        "unscheduled": p_unsched,
+    }
+    if (s_placed, s_unsched) != (p_placed, p_unsched):
+        # the sanitizer must be observational — a placement drift is a bug
+        out["error"] = (
+            f"sanitized run placed {s_placed}/{s_unsched} vs plain "
+            f"{p_placed}/{p_unsched}"
+        )
+    return out
+
+
 CONFIGS = {
     "stock": config_stock,
     "fit_1k_100n": config_fit,
+    "sanitize_overhead_1k": config_sanitize_overhead,
     "spread_aff_10k_1k": config_spread_affinity,
     "gpushare_5k": config_gpushare,
     "plan_100k_10k": config_plan,
@@ -681,6 +730,7 @@ SEGMENT_TIMEOUT_S = {
     "headline_mid": 600.0,
     "stock": 900.0,
     "fit_1k_100n": 600.0,
+    "sanitize_overhead_1k": 900.0,
     "spread_aff_10k_1k": 900.0,
     "gpushare_5k": 900.0,
     "plan_100k_10k": 1200.0,

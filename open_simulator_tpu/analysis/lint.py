@@ -19,9 +19,11 @@ name from a jit-reachable body (including function-valued arguments to
 imports are resolved within the package, so a helper in ``ops/encode.py``
 called from a jitted body in ``ops/fast.py`` is covered.
 
-Suppressions: append ``# osim: lint-ok[rule-id]`` to the flagged line.
-Every suppression should carry a one-line justification on the same or
-the preceding line.
+Suppressions: append an ``osim: lint-ok[rule-id]`` comment to the flagged
+line. Every suppression should carry a one-line justification on the same
+or the preceding line; suppressions that no longer match a finding are
+reported as ``unused-suppression`` so they cannot rot into cover for a
+future real finding.
 """
 
 from __future__ import annotations
@@ -503,6 +505,34 @@ def run_lint(
                 if f.rule in sup:
                     f.suppressed = True
             findings.append(f)
+    if wanted is None:
+        # Every rule ran, so a suppression comment that matched nothing is
+        # stale — report it before it rots into cover for a future real
+        # finding. (Skipped under --rules: a filtered run can't tell.)
+        used = {
+            (f.path, f.line, f.rule) for f in findings if f.suppressed
+        }
+        for mod in ctx.modules.values():
+            for line, rules in sorted(mod.suppressions.items()):
+                for rid in sorted(rules):
+                    if (mod.path, line, rid) not in used:
+                        findings.append(
+                            Finding(
+                                rule="unused-suppression",
+                                path=mod.path,
+                                line=line,
+                                col=0,
+                                message=(
+                                    f"suppression lint-ok[{rid}] matches no "
+                                    f"finding on this line"
+                                    + (
+                                        ""
+                                        if rid in _RULES
+                                        else f" (unknown rule id {rid!r})"
+                                    )
+                                ),
+                            )
+                        )
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return LintReport(
         findings=findings,

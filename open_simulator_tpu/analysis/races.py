@@ -1,0 +1,650 @@
+"""Lock-discipline race detector over the lint engine's package model.
+
+The threaded surface of the simulator is small but real: the metrics
+server handles every request on its own thread (ThreadingHTTPServer), the
+chaos/apply paths run in the main thread, and SIGTERM lands in a signal
+handler. This pass reconstructs, purely from the AST model lint.py already
+builds:
+
+  1. **shared mutable state** — module-level dict/list/set bindings plus
+     module-level scalars that some function rebinds through ``global``;
+  2. **locks** — module-level ``threading.Lock()``/``RLock()``/
+     ``Semaphore()``-style bindings;
+  3. **thread roots** — methods of ``BaseHTTPRequestHandler`` subclasses,
+     ``threading.Thread(target=...)`` targets and ``signal.signal``
+     handlers, then everything reachable from them through the call graph
+     (with ``self.method`` resolution inside classes).
+
+Any read-modify-write of a shared scalar (AugAssign, ``x = f(x)``, or a
+read + rebind pair in one function) and any container mutation
+(``.append``/``.pop``/``x[k] = v``/``del x[k]`` …) performed in an
+audited function without a dominating ``with <lock>:`` block is reported.
+A *plain single rebind* of a scalar with no read in the same function is
+an atomic publish under the GIL and is deliberately not flagged.
+
+Escapes:
+
+  * ``@guarded_by("lockname")`` (utils/concurrency.py) asserts every
+    caller already holds the named module-level lock; the body is then
+    treated as dominated by it. The annotation is trusted — it exists for
+    guards the detector cannot see (e.g. a ``Semaphore.acquire`` in the
+    caller).
+  * an ``osim: audit-ok[race]`` comment on the flagged line suppresses
+    it; unused suppressions are themselves reported so they cannot rot.
+
+Functions living in a module that *defines* a thread root are audited
+even when not reachable from one: once handler threads exist in the
+process, main-thread writes to the same state race against them.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .lint import FunctionInfo, LintContext, ModuleInfo, build_context
+
+AUDIT_SUPPRESS_RE = re.compile(
+    r"#\s*osim:\s*audit-ok\[([a-z0-9-]+(?:\s*,\s*[a-z0-9-]+)*)\]"
+)
+
+RULE_RACE = "race"
+
+_LOCK_FACTORIES = {
+    "Lock", "RLock", "Semaphore", "BoundedSemaphore", "Condition", "Event",
+}
+_CONTAINER_FACTORIES = {
+    "dict", "list", "set", "deque", "defaultdict", "OrderedDict", "Counter",
+}
+_MUTATING_METHODS = {
+    "append", "add", "update", "pop", "popitem", "clear", "remove",
+    "discard", "extend", "insert", "setdefault", "appendleft", "popleft",
+    "sort", "reverse",
+}
+_HANDLER_BASES = ("BaseHTTPRequestHandler", "SimpleHTTPRequestHandler")
+
+
+# ---------------------------------------------------------------------------
+# findings / report
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class RaceFinding:
+    path: str
+    line: int
+    col: int
+    state: str        # dotted shared object, e.g. server.server._snapshot
+    function: str     # module:qualname performing the access
+    access: str       # rmw | mutate | check-then-act
+    thread_root: str  # why this function is audited
+    message: str
+    suppressed: bool = False
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.state)
+
+    def to_dict(self) -> dict:
+        d = {
+            "rule": RULE_RACE,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "state": self.state,
+            "function": self.function,
+            "access": self.access,
+            "thread_root": self.thread_root,
+            "message": self.message,
+        }
+        if self.suppressed:
+            d["suppressed"] = True
+        return d
+
+    def render(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return (
+            f"{self.path}:{self.line}:{self.col}: race: {self.message} "
+            f"[via {self.thread_root}]{tag}"
+        )
+
+
+@dataclasses.dataclass
+class UnusedSuppression:
+    path: str
+    line: int
+    rule: str
+
+    def to_dict(self) -> dict:
+        return {"path": self.path, "line": self.line, "rule": self.rule}
+
+
+@dataclasses.dataclass
+class RaceAuditReport:
+    findings: List[RaceFinding]
+    unused_suppressions: List[UnusedSuppression]
+    shared_objects: List[str]
+    locks: List[str]
+    thread_roots: List[str]
+    audited_functions: int
+
+    @property
+    def active(self) -> List[RaceFinding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def ok(self) -> bool:
+        return not self.active and not self.unused_suppressions
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "findings": [f.to_dict() for f in self.active],
+            "suppressed": [f.to_dict() for f in self.findings if f.suppressed],
+            "unused_suppressions": [
+                u.to_dict() for u in self.unused_suppressions
+            ],
+            "shared_objects": self.shared_objects,
+            "locks": self.locks,
+            "thread_roots": self.thread_roots,
+            "audited_functions": self.audited_functions,
+        }
+
+    def render_text(self) -> str:
+        out = [f.render() for f in self.active]
+        for u in self.unused_suppressions:
+            out.append(
+                f"{u.path}:{u.line}: unused audit suppression "
+                f"[audit-ok[{u.rule}]] — no finding on this line"
+            )
+        n_sup = sum(1 for f in self.findings if f.suppressed)
+        out.append(
+            f"races: {len(self.active)} finding(s), {n_sup} suppressed, "
+            f"{len(self.unused_suppressions)} stale suppression(s) — "
+            f"{len(self.shared_objects)} shared object(s), "
+            f"{len(self.locks)} lock(s), {len(self.thread_roots)} thread "
+            f"root(s), {self.audited_functions} audited function(s)"
+        )
+        return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# shared-state / lock collection
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ModuleShared:
+    containers: Set[str] = dataclasses.field(default_factory=set)
+    scalars: Set[str] = dataclasses.field(default_factory=set)
+    locks: Set[str] = dataclasses.field(default_factory=set)
+
+
+def _module_level_assigns(tree: ast.Module) -> Iterator[ast.Assign]:
+    """Module-level Assign statements, descending through top-level
+    if/try blocks (e.g. `if TYPE_CHECKING` or platform guards)."""
+    stack: List[ast.AST] = list(tree.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Assign):
+            yield node
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            # `_breakers: Dict[str, Breaker] = {}` — same binding, typed
+            if isinstance(node.target, ast.Name):
+                synth = ast.Assign(targets=[node.target], value=node.value)
+                yield synth
+        elif isinstance(node, (ast.If, ast.Try)):
+            for fld in ("body", "orelse", "finalbody", "handlers"):
+                for child in getattr(node, fld, []):
+                    if isinstance(child, ast.ExceptHandler):
+                        stack.extend(child.body)
+                    else:
+                        stack.append(child)
+
+
+def _callee_name(call: ast.Call) -> str:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def collect_shared(mod: ModuleInfo) -> ModuleShared:
+    out = ModuleShared()
+    candidates_scalar: Set[str] = set()
+    for node in _module_level_assigns(mod.tree):
+        names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        # tuple targets: `_breakers, _lock = {}, Lock()` style
+        for t in node.targets:
+            if isinstance(t, ast.Tuple):
+                names.extend(
+                    e.id for e in t.elts if isinstance(e, ast.Name)
+                )
+        if not names:
+            continue
+        v = node.value
+        if isinstance(v, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                          ast.DictComp, ast.SetComp)):
+            out.containers.update(names)
+        elif isinstance(v, ast.Call):
+            callee = _callee_name(v)
+            if callee in _LOCK_FACTORIES:
+                out.locks.update(names)
+            elif callee in _CONTAINER_FACTORIES:
+                out.containers.update(names)
+        elif isinstance(v, ast.Constant):
+            candidates_scalar.update(names)
+        elif isinstance(v, ast.Tuple) and isinstance(node.targets[0], ast.Tuple):
+            # `a, b = 1, {}` — classify element-wise
+            tgt = node.targets[0]
+            for te, ve in zip(tgt.elts, v.elts):
+                if not isinstance(te, ast.Name):
+                    continue
+                if isinstance(ve, (ast.Dict, ast.List, ast.Set)):
+                    out.containers.add(te.id)
+                elif isinstance(ve, ast.Constant):
+                    candidates_scalar.add(te.id)
+
+    # a scalar is shared-mutable only if some function rebinds it via global
+    globally_written: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            declared: Set[str] = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Global):
+                    declared.update(sub.names)
+            if declared:
+                for sub in ast.walk(node):
+                    if (
+                        isinstance(sub, ast.Name)
+                        and isinstance(sub.ctx, ast.Store)
+                        and sub.id in declared
+                    ):
+                        globally_written.add(sub.id)
+    out.scalars = candidates_scalar & globally_written
+    return out
+
+
+# ---------------------------------------------------------------------------
+# thread roots + reachability
+# ---------------------------------------------------------------------------
+
+def _class_of(qual: str) -> str:
+    return qual.rsplit(".", 1)[0] if "." in qual else ""
+
+def _is_handler_class(cls: ast.ClassDef) -> bool:
+    for base in cls.bases:
+        name = base.attr if isinstance(base, ast.Attribute) else getattr(
+            base, "id", ""
+        )
+        if any(h in name for h in _HANDLER_BASES):
+            return True
+    return False
+
+
+def thread_roots(ctx: LintContext) -> Dict[Tuple[str, str], str]:
+    """(module, qualname) -> human-readable root reason."""
+    roots: Dict[Tuple[str, str], str] = {}
+    for mod in ctx.modules.values():
+        # 1. request-handler methods run on server threads
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef) and _is_handler_class(node):
+                for info in mod.functions.values():
+                    if _class_of(info.qualname) == node.name:
+                        roots[(mod.name, info.qualname)] = (
+                            f"handler thread {mod.name}:{info.qualname}"
+                        )
+        # 2. Thread(target=...) and signal.signal(..., handler)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _callee_name(node)
+            target_exprs: List[ast.expr] = []
+            reason = ""
+            if callee == "Thread":
+                target_exprs = [
+                    kw.value for kw in node.keywords if kw.arg == "target"
+                ]
+                reason = "thread target"
+            elif callee == "signal" and len(node.args) >= 2:
+                target_exprs = [node.args[1]]
+                reason = "signal handler"
+            elif callee == "Timer" and len(node.args) >= 2:
+                target_exprs = [node.args[1]]
+                reason = "timer thread"
+            for expr in target_exprs:
+                resolved = ctx.resolve_call(mod, expr)
+                if resolved is not None:
+                    roots[resolved] = (
+                        f"{reason} {resolved[0]}:{resolved[1]}"
+                    )
+    return roots
+
+
+def _calls_from(ctx: LintContext, mod: ModuleInfo,
+                info: FunctionInfo) -> Iterator[Tuple[str, str]]:
+    cls = _class_of(info.qualname)
+    for node in ast.walk(info.node):
+        if not isinstance(node, ast.Call):
+            continue
+        resolved = ctx.resolve_call(mod, node.func)
+        if resolved is not None:
+            yield resolved
+        f = node.func
+        if (
+            cls
+            and isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "self"
+        ):
+            sibling = f"{cls}.{f.attr}"
+            if any(i.qualname == sibling for i in mod.functions.values()):
+                yield (mod.name, sibling)
+
+
+def audited_functions(
+    ctx: LintContext, roots: Dict[Tuple[str, str], str]
+) -> Dict[Tuple[str, str], str]:
+    """Thread-reachable closure of the roots, plus every function in a
+    module that defines a root (main-thread code racing the handlers)."""
+    audited: Dict[Tuple[str, str], str] = {}
+    work = [(key, reason) for key, reason in sorted(roots.items())]
+    while work:
+        key, reason = work.pop()
+        if key in audited:
+            continue
+        audited[key] = reason
+        mod = ctx.modules.get(key[0])
+        if mod is None:
+            continue
+        info = next(
+            (i for i in mod.functions.values() if i.qualname == key[1]), None
+        )
+        if info is None:
+            continue
+        for tgt in _calls_from(ctx, mod, info):
+            if tgt not in audited:
+                work.append((tgt, reason))
+
+    root_modules = {m for (m, _q) in roots}
+    for mod_name in root_modules:
+        mod = ctx.modules[mod_name]
+        for info in mod.functions.values():
+            key = (mod_name, info.qualname)
+            if key not in audited:
+                audited[key] = f"module hosts thread roots ({mod_name})"
+    return audited
+
+
+# ---------------------------------------------------------------------------
+# per-function access scan
+# ---------------------------------------------------------------------------
+
+def _guarded_by_decorator(info: FunctionInfo) -> Optional[str]:
+    node = info.node
+    for dec in getattr(node, "decorator_list", []):
+        if isinstance(dec, ast.Call):
+            name = _callee_name(dec)
+            if name == "guarded_by" and dec.args:
+                a = dec.args[0]
+                if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                    return a.value
+    return None
+
+
+def _with_locks(node: ast.With, locks: Set[str],
+                mod: ModuleInfo, ctx: LintContext) -> Set[str]:
+    held: Set[str] = set()
+    for item in node.items:
+        e = item.context_expr
+        if isinstance(e, ast.Name) and e.id in locks:
+            held.add(e.id)
+        elif isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name):
+            target = _imported_module(mod, e.value.id, ctx)
+            if target is not None:
+                # with othermod.lock: — trust the name, shape checked there
+                held.add(f"{target}:{e.attr}")
+    return held
+
+
+def _imported_module(mod: ModuleInfo, local: str,
+                     ctx: LintContext) -> Optional[str]:
+    """Dotted module name a local name refers to: `import pkg.sub as m`
+    gives (pkg.sub, None); `from pkg import sub` gives (pkg, sub) with
+    pkg.sub itself a module."""
+    imp = mod.imports.get(local)
+    if imp is None:
+        return None
+    target = imp[0] if imp[1] is None else f"{imp[0]}.{imp[1]}"
+    return target if target in ctx.modules else None
+
+
+def _shared_ref(
+    expr: ast.expr, mod: ModuleInfo, ctx: LintContext,
+    shared: Dict[str, ModuleShared],
+) -> Optional[Tuple[str, str]]:
+    """Resolve an expression to (module, name) of a shared container."""
+    if isinstance(expr, ast.Name):
+        if expr.id in shared[mod.name].containers:
+            return (mod.name, expr.id)
+        imp = mod.imports.get(expr.id)
+        if (
+            imp is not None
+            and imp[1] is not None
+            and imp[0] in shared
+            and imp[1] in shared[imp[0]].containers
+        ):
+            return (imp[0], imp[1])
+    elif isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+        target = _imported_module(mod, expr.value.id, ctx)
+        if (
+            target is not None
+            and target in shared
+            and expr.attr in shared[target].containers
+        ):
+            return (target, expr.attr)
+    return None
+
+
+def _scan_function(
+    ctx: LintContext,
+    mod: ModuleInfo,
+    info: FunctionInfo,
+    shared: Dict[str, ModuleShared],
+    root_reason: str,
+    findings: List[RaceFinding],
+) -> None:
+    my_shared = shared[mod.name]
+    anno = _guarded_by_decorator(info)
+    base_held: Set[str] = {anno} if anno else set()
+
+    declared_global: Set[str] = set()
+    for sub in ast.walk(info.node):
+        if isinstance(sub, ast.Global):
+            declared_global.update(sub.names)
+    watched_scalars = declared_global & my_shared.scalars
+
+    # (name -> [(node, kind 'r'/'w', held?)]) for scalar RMW analysis
+    scalar_events: Dict[str, List[Tuple[ast.AST, str, bool]]] = {}
+
+    def emit(node: ast.AST, state: Tuple[str, str], access: str, msg: str):
+        findings.append(
+            RaceFinding(
+                path=mod.path,
+                line=getattr(node, "lineno", 0),
+                col=getattr(node, "col_offset", 0),
+                state=f"{state[0]}.{state[1]}",
+                function=f"{mod.name}:{info.qualname}",
+                access=access,
+                thread_root=root_reason,
+                message=msg,
+            )
+        )
+
+    def container_mutation(node: ast.AST, held: bool):
+        # x.append(...) / x[k] = v / del x[k] / x[k] += v
+        target: Optional[ast.expr] = None
+        verb = ""
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in _MUTATING_METHODS:
+                target, verb = f.value, f".{f.attr}()"
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            tgts = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in tgts:
+                if isinstance(t, ast.Subscript):
+                    target, verb = t.value, "[...] ="
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    target, verb = t.value, "del [...]"
+        if target is None:
+            return
+        ref = _shared_ref(target, mod, ctx, shared)
+        if ref is not None and not held:
+            emit(
+                node, ref, "mutate",
+                f"unguarded mutation `{ref[1]}{verb}` of shared "
+                f"module state {ref[0]}.{ref[1]} — wrap in `with <lock>:` "
+                f"or annotate the function @guarded_by(...)",
+            )
+
+    def visit(node: ast.AST, held: Set[str]):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and (
+            node is not info.node
+        ):
+            return  # nested def runs later, on its own audit entry
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new = held | _with_locks(node, my_shared.locks, mod, ctx)
+            for item in node.items:
+                visit(item.context_expr, held)
+            for child in node.body:
+                visit(child, new)
+            return
+        guarded = bool(held)
+        container_mutation(node, guarded)
+        if isinstance(node, ast.Name) and node.id in watched_scalars:
+            kind = "w" if isinstance(node.ctx, ast.Store) else "r"
+            scalar_events.setdefault(node.id, []).append(
+                (node, kind, guarded)
+            )
+        if isinstance(node, ast.AugAssign) and isinstance(
+            node.target, ast.Name
+        ) and node.target.id in watched_scalars:
+            # AugAssign's target Name has Store ctx; record the read half too
+            scalar_events.setdefault(node.target.id, []).append(
+                (node, "r", guarded)
+            )
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in info.node.body:  # type: ignore[attr-defined]
+        visit(stmt, set(base_held))
+
+    for name, events in sorted(scalar_events.items()):
+        reads = [e for e in events if e[1] == "r"]
+        writes = [e for e in events if e[1] == "w"]
+        if not writes or not reads:
+            continue  # pure publish or pure read: atomic under the GIL
+        unguarded = [e for e in events if not e[2]]
+        if not unguarded:
+            continue
+        node = writes[0][0]
+        access = (
+            "rmw"
+            if any(isinstance(e[0], ast.AugAssign) for e in events)
+            else "check-then-act"
+        )
+        emit(
+            node, (mod.name, name), access,
+            f"read-modify-write of shared scalar {mod.name}.{name} with "
+            f"{len(unguarded)} unguarded access(es) — a concurrent thread "
+            f"can interleave between the read and the write",
+        )
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def _audit_suppressions(mod: ModuleInfo) -> Dict[int, Set[str]]:
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(mod.lines, start=1):
+        m = AUDIT_SUPPRESS_RE.search(line)
+        if m:
+            out[i] = {p.strip() for p in m.group(1).split(",")}
+    return out
+
+
+def run_races(
+    package_root: Optional[str] = None,
+    report_root: Optional[str] = None,
+    ctx: Optional[LintContext] = None,
+) -> RaceAuditReport:
+    if ctx is None:
+        ctx = build_context(package_root, report_root)
+
+    shared = {m.name: collect_shared(m) for m in ctx.modules.values()}
+    roots = thread_roots(ctx)
+    audited = audited_functions(ctx, roots)
+
+    findings: List[RaceFinding] = []
+    for (mod_name, qual), reason in sorted(audited.items()):
+        mod = ctx.modules[mod_name]
+        info = next(
+            (i for i in mod.functions.values() if i.qualname == qual), None
+        )
+        if info is not None:
+            _scan_function(ctx, mod, info, shared, reason, findings)
+
+    # dedupe (a function reachable from several roots scans once per (line,
+    # state) anyway; reachability map already collapses roots)
+    uniq: Dict[Tuple, RaceFinding] = {}
+    for f in findings:
+        uniq.setdefault((f.path, f.line, f.col, f.state, f.access), f)
+    findings = sorted(uniq.values(), key=RaceFinding.sort_key)
+
+    # apply + cross-check audit-ok suppressions
+    used: Set[Tuple[str, int, str]] = set()
+    sup_by_mod = {m.name: _audit_suppressions(m) for m in ctx.modules.values()}
+    path_to_mod = {m.path: m.name for m in ctx.modules.values()}
+    for f in findings:
+        mod_name = path_to_mod.get(f.path)
+        if mod_name is None:
+            continue
+        sup = sup_by_mod[mod_name].get(f.line, set())
+        if RULE_RACE in sup:
+            f.suppressed = True
+            used.add((f.path, f.line, RULE_RACE))
+
+    unused: List[UnusedSuppression] = []
+    for mod in ctx.modules.values():
+        for line, rules in sorted(sup_by_mod[mod.name].items()):
+            for r in sorted(rules):
+                if r != RULE_RACE:
+                    unused.append(UnusedSuppression(mod.path, line, r))
+                elif (mod.path, line, r) not in used:
+                    unused.append(UnusedSuppression(mod.path, line, r))
+
+    shared_objects = sorted(
+        f"{name}.{obj}"
+        for name, s in shared.items()
+        for obj in (s.containers | s.scalars)
+    )
+    locks = sorted(
+        f"{name}.{lk}" for name, s in shared.items() for lk in s.locks
+    )
+    return RaceAuditReport(
+        findings=findings,
+        unused_suppressions=unused,
+        shared_objects=shared_objects,
+        locks=locks,
+        thread_roots=sorted(set(roots.values())),
+        audited_functions=len(audited),
+    )
+
+
+def report_json(report: RaceAuditReport) -> str:
+    return json.dumps(report.to_dict(), indent=2, sort_keys=True)

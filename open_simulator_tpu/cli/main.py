@@ -1,4 +1,4 @@
-"""simon CLI: apply / server / lint / version / gen-doc.
+"""simon CLI: apply / server / lint / audit / version / gen-doc.
 
 Parity: `/root/reference/cmd/` (cobra commands → argparse subcommands):
   apply   -f/--simon-config, --output-file, -i/--interactive, --use-greed,
@@ -222,6 +222,58 @@ def _run_chaos(args) -> int:
     return 0
 
 
+def _add_audit(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "audit",
+        help="semantic verification: concurrency race detector + jaxpr "
+        "numeric-invariant prover",
+        description=(
+            "Run the semantic audit passes: the lock-discipline race "
+            "detector over thread-reachable code (server handlers, thread "
+            "targets, signal handlers) and the abstract interpreter that "
+            "re-traces every registered jit entry point, proving mask "
+            "outputs stay in {0,1}, score plugins stay in [0,100], and no "
+            "NaN can reach a selection primitive. Deterministic output; "
+            "exit 0 = clean. The runtime companion is OSIM_SANITIZE=1. "
+            "See docs/static-analysis.md."
+        ),
+    )
+    p.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output format (json is the machine-readable CI artifact)",
+    )
+    p.add_argument(
+        "--no-races", action="store_true",
+        help="skip the concurrency race detector",
+    )
+    p.add_argument(
+        "--no-invariants", action="store_true",
+        help="skip the jaxpr invariant prover (pure-AST mode: no jax "
+        "import, suitable for pre-commit hooks)",
+    )
+
+
+def _run_audit(args) -> int:
+    from ..analysis.audit import run_semantic_audit
+
+    if not args.no_invariants:
+        # the invariant pass traces jitted entries — pin the platform the
+        # same way apply/server do before jax initializes
+        from ..utils.platform import ensure_platform
+        from ..utils.tracing import init_logging
+
+        init_logging()
+        ensure_platform()
+    report = run_semantic_audit(
+        races=not args.no_races, invariants=not args.no_invariants
+    )
+    if args.format == "json":
+        print(report.to_json())
+    else:
+        print(report.render_text())
+    return 0 if report.ok else 1
+
+
 def _run_lint(args) -> int:
     import json as _json
 
@@ -276,6 +328,7 @@ def main(argv=None) -> int:
     )
     sub = parser.add_subparsers(dest="command")
     _add_apply(sub)
+    _add_audit(sub)
     _add_chaos(sub)
     _add_lint(sub)
     ps = sub.add_parser(
@@ -315,6 +368,8 @@ def main(argv=None) -> int:
         return 0
     if args.command == "chaos":
         return _run_chaos(args)
+    if args.command == "audit":
+        return _run_audit(args)
     if args.command == "lint":
         return _run_lint(args)
     if args.command == "gen-doc":

@@ -76,6 +76,7 @@ from .kernels import (
     ports_mask,
     resource_fail,
 )
+from .sanitize import sanitizable
 from .state import pod_rows_from_batch
 from ..utils import metrics as _metrics
 
@@ -144,6 +145,7 @@ class Trajectory(NamedTuple):
     packed: jnp.ndarray       # f32[N,J,N_CH]
 
 
+@sanitizable("ops.fast:build_trajectory", static_argnames=("j_steps",))
 @functools.partial(jax.jit, static_argnames=("j_steps",))
 def build_trajectory(
     ns: NodeStatic,
@@ -437,8 +439,12 @@ def _light_eval(
             axis=1,
         )
         mx_sp = jnp.max(jnp.where(ns.valid, raw_sp, 0.0))
-        sp_score = jnp.where(
-            mx_sp > 0, (mx_sp - raw_sp) * 100.0 / jnp.maximum(mx_sp, 1e-9),
+        sp_score = jnp.clip(
+            jnp.where(
+                mx_sp > 0, (mx_sp - raw_sp) * 100.0 / jnp.maximum(mx_sp, 1e-9),
+                100.0,
+            ),
+            0.0,
             100.0,
         )
     else:
@@ -555,6 +561,7 @@ def _sortable(flags: GroupFlags) -> bool:
     )
 
 
+@sanitizable("ops.fast:sort_select", static_argnames=("out_size",))
 @functools.partial(jax.jit, static_argnames=("out_size",))
 def sort_select(
     ns: NodeStatic,
@@ -613,6 +620,7 @@ def sort_select(
     return mono_ok, nodes, jidx, x
 
 
+@sanitizable("ops.fast:cur_at")
 @jax.jit
 def cur_at(traj: Trajectory, x: jnp.ndarray) -> jnp.ndarray:
     """packed[n, x_n] for every node (reason attribution after a sort-path
@@ -639,6 +647,7 @@ assert SP_IDX == len(WEIGHT_ORDER) - 1 and IPA_IDX == SP_IDX - 1, (
 )
 
 
+@sanitizable("ops.fast:light_scan", static_argnames=("group_size", "flags"))
 @functools.partial(jax.jit, static_argnames=("group_size", "flags"))
 def light_scan(
     ns: NodeStatic,
@@ -788,7 +797,11 @@ def _spread_norm(raw: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
     kernels.score_topology_spread on reconstructed counts); `valid` masks
     which entries may define the max."""
     mx = jnp.max(jnp.where(valid, raw, 0.0))
-    return jnp.where(mx > 0, (mx - raw) * 100.0 / jnp.maximum(mx, 1e-9), 100.0)
+    return jnp.clip(
+        jnp.where(mx > 0, (mx - raw) * 100.0 / jnp.maximum(mx, 1e-9), 100.0),
+        0.0,
+        100.0,
+    )
 
 
 def _hard_spread_ok(dom, cnt, in_key_cd, hard_c, skew, has_key, f_spread_on):
@@ -985,6 +998,11 @@ def _domain_plan(
     )
 
 
+@sanitizable(
+    "ops.fast:domain_select",
+    static_argnames=("group_size", "l_cap", "flags", "use_pallas"),
+    skip_kwargs=("use_pallas",),
+)
 @functools.partial(
     jax.jit, static_argnames=("group_size", "l_cap", "flags", "use_pallas")
 )
@@ -1374,6 +1392,7 @@ def _domain_pop_pallas(
     return nodes[0], jidxs[0]
 
 
+@sanitizable("ops.fast:light_reasons", static_argnames=("flags",))
 @functools.partial(jax.jit, static_argnames=("flags",))
 def light_reasons(
     ns: NodeStatic,
@@ -1428,6 +1447,7 @@ def light_reasons(
     ].add(jnp.where((first_fail < NUM_FILTERS) & ns.valid, 1, 0))
 
 
+@sanitizable("ops.fast:gather_takes")
 @jax.jit
 def gather_takes(traj: Trajectory, nodes: jnp.ndarray, jidxs: jnp.ndarray):
     """Per-pod allocation takes from (chosen node, commit index) — one gather
@@ -1441,6 +1461,7 @@ def gather_takes(traj: Trajectory, nodes: jnp.ndarray, jidxs: jnp.ndarray):
     return gpu_take, vg_take, dev_take
 
 
+@sanitizable("ops.fast:exit_carry")
 @jax.jit
 def exit_carry(
     ns: NodeStatic, carry0: Carry, pod: PodRow, traj: Trajectory, x: jnp.ndarray

@@ -64,6 +64,7 @@ from .kernels import (
     spread_mask,
     taint_mask,
 )
+from .sanitize import sanitizable
 from .state import pod_rows_from_batch
 
 # Default cap on per-group device-program length (scan steps per dispatch) —
@@ -243,6 +244,12 @@ _group_jit = jax.jit(
     schedule_group,
     static_argnames=("group_size", "extra_filters", "extra_scores"),
 )
+# Separate statement: lint's jit-root detection keys off the `jax.jit(...)`
+# assignment above, and sanitize delegates .trace back to it.
+_group_jit = sanitizable(
+    "ops.grouped:_group_jit",
+    static_argnames=("group_size", "extra_filters", "extra_scores"),
+)(_group_jit)
 
 
 def _group_call(

@@ -37,6 +37,7 @@ from .encode import (
     OP_PAD,
     OP_EXISTS,
 )
+from .sanitize import sanitizable
 
 # Filter indices — order mirrors the kube filter plugin order so the
 # first-failure reason attribution matches the reference's diagnostics.
@@ -812,7 +813,11 @@ def _minmax_normalize(score: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
     lo = jnp.min(jnp.where(valid, score, jnp.inf))
     hi = jnp.max(jnp.where(valid, score, -jnp.inf))
     rng = hi - lo
-    return jnp.where(rng > 0, (score - lo) * 100.0 / jnp.maximum(rng, 1e-9), 0.0)
+    out = jnp.where(rng > 0, (score - lo) * 100.0 / jnp.maximum(rng, 1e-9), 0.0)
+    # Exact no-op for valid lanes (fl((score-lo)*100/rng) <= 100 by monotone
+    # rounding when score <= hi); pins invalid lanes so the plugin contract
+    # score in [0,100] (framework's checkPluginScores) holds for every lane.
+    return jnp.clip(out, 0.0, 100.0)
 
 
 def score_least_allocated(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
@@ -860,7 +865,11 @@ def score_taint_toleration(ns: NodeStatic, pod: PodRow) -> jnp.ndarray:
     tolerated = jnp.any(pod.tol_valid[None, None, :] & eff_ok & key_ok & val_ok, axis=2)
     cnt = jnp.sum(((te == 2) & ~tolerated).astype(jnp.float32), axis=1)
     max_cnt = jnp.max(jnp.where(ns.valid, cnt, 0.0))
-    return jnp.where(max_cnt > 0, (max_cnt - cnt) * 100.0 / jnp.maximum(max_cnt, 1e-9), 100.0)
+    return jnp.clip(
+        jnp.where(max_cnt > 0, (max_cnt - cnt) * 100.0 / jnp.maximum(max_cnt, 1e-9), 100.0),
+        0.0,
+        100.0,
+    )
 
 
 def score_node_affinity(ns: NodeStatic, pod: PodRow) -> jnp.ndarray:
@@ -873,7 +882,9 @@ def score_node_affinity(ns: NodeStatic, pod: PodRow) -> jnp.ndarray:
     )(pod.pref_op, pod.pref_key, pod.pref_val, pod.pref_num)    # [N,PREF]
     raw = jnp.sum(hits * pod.pref_weight[None, :], axis=1)
     mx = jnp.max(jnp.where(ns.valid, raw, 0.0))
-    return jnp.where(mx > 0, raw * 100.0 / jnp.maximum(mx, 1e-9), 0.0)
+    return jnp.clip(
+        jnp.where(mx > 0, raw * 100.0 / jnp.maximum(mx, 1e-9), 0.0), 0.0, 100.0
+    )
 
 
 def score_prefer_avoid(ns: NodeStatic, pod: PodRow) -> jnp.ndarray:
@@ -904,7 +915,11 @@ def score_topology_spread(
         axis=1,
     )
     mx = jnp.max(jnp.where(ns.valid, raw, 0.0))
-    return jnp.where(mx > 0, (mx - raw) * 100.0 / jnp.maximum(mx, 1e-9), 100.0)
+    return jnp.clip(
+        jnp.where(mx > 0, (mx - raw) * 100.0 / jnp.maximum(mx, 1e-9), 100.0),
+        0.0,
+        100.0,
+    )
 
 
 def score_inter_pod_affinity(ns: NodeStatic, carry: Carry, pod: PodRow) -> jnp.ndarray:
@@ -1051,6 +1066,9 @@ def schedule_step(
     )
 
 
+@sanitizable(
+    "ops.kernels:probe_step", static_argnames=("extra_filters", "extra_scores")
+)
 @functools.partial(jax.jit, static_argnames=("extra_filters", "extra_scores"))
 def probe_step(
     ns: NodeStatic,
@@ -1072,6 +1090,7 @@ def probe_step(
     return mask & ns.valid, score, first_fail
 
 
+@sanitizable("ops.kernels:commit_step")
 @jax.jit
 def commit_step(ns: NodeStatic, carry: Carry, pod: PodRow, node):
     """Commit ONE pod to node index `node` (i32 scalar; -1 = no commit).
@@ -1084,6 +1103,10 @@ def commit_step(ns: NodeStatic, carry: Carry, pod: PodRow, node):
     return new_carry, gpu_take.astype(jnp.int32), vg_take, dev_take
 
 
+@sanitizable(
+    "ops.kernels:schedule_batch",
+    static_argnames=("extra_filters", "extra_scores"),
+)
 @functools.partial(jax.jit, static_argnames=("extra_filters", "extra_scores"))
 def schedule_batch(
     ns: NodeStatic,

@@ -37,6 +37,7 @@ from ..core.objects import (
 )
 from ..engine.simulator import AppResource, ClusterResource, simulate
 from ..utils import metrics
+from ..utils.concurrency import guarded_by
 from ..utils.yamlio import objects_from_directory
 
 _busy = threading.Lock()
@@ -79,6 +80,7 @@ class _DrainingHTTPServer(ThreadingHTTPServer):
     daemon_threads = False
 
 
+@guarded_by("_busy")
 def _live_snapshot() -> ClusterResource:
     """Cached kubeconfig/master-backed cluster snapshot. Returns a fresh
     ClusterResource wrapper over shared immutable objects: request handling
@@ -264,6 +266,11 @@ def _goroutine_dump() -> dict:
 
 
 _tracemalloc_on = False
+# /debug/pprof/heap is served off _Handler threads with no _busy gating, so
+# two concurrent requests can both observe _tracemalloc_on False, both call
+# tracemalloc.start() and both mislabel their snapshot "tracing just
+# started" — serialize the check-then-act.
+_tracemalloc_lock = threading.Lock()
 
 
 def _heap_profile() -> dict:
@@ -274,10 +281,11 @@ def _heap_profile() -> dict:
     import tracemalloc
 
     global _tracemalloc_on
-    first = not _tracemalloc_on
-    if first:
-        tracemalloc.start(10)
-        _tracemalloc_on = True
+    with _tracemalloc_lock:
+        first = not _tracemalloc_on
+        if first:
+            tracemalloc.start(10)
+            _tracemalloc_on = True
     current, peak = tracemalloc.get_traced_memory()
     snap = tracemalloc.take_snapshot()
     stats = snap.statistics("lineno")[:25]

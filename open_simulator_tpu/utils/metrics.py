@@ -479,6 +479,12 @@ FAULTS_INJECTED = REGISTRY.counter(
     "Faults injected by the resilience fault-injection harness.",
     labelnames=("target", "kind"),
 )
+SANITIZER_VIOLATIONS = REGISTRY.counter(
+    "osim_sanitizer_violations_total",
+    "checkify violations (NaN/OOB/div) caught by OSIM_SANITIZE=1 runs, by "
+    "jit entry point.",
+    labelnames=("entry",),
+)
 
 # Span names that map onto a dedicated kube-parity histogram; everything
 # else lands only in osim_span_duration_seconds{span=...}.

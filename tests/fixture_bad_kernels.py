@@ -1,0 +1,41 @@
+"""Known-violation kernels for the invariant abstract interpreter.
+
+Each function reproduces, in isolation, a bug class the production
+kernels are proved free of — the audit MUST flag these, deterministically,
+or the prover is vacuous. Never imported by production code.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_sentinel_select(score, valid):
+    """The -inf * 0.0 poisoning pattern (what fast.py's masked score lanes
+    would become without the guard shape): invalid lanes hold -inf, the
+    one-hot zeroes them by multiplication, and 0 * -inf makes NaN — which
+    then feeds argmax, where NaN compares unpredictably."""
+    masked = jnp.where(valid, score, -jnp.inf)
+    onehot = (masked == jnp.max(masked)).astype(jnp.float32)
+    contrib = masked * onehot
+    return jnp.argmax(contrib), jnp.sum(contrib)
+
+
+def bad_normalize(score):
+    """Min-max normalization without the rng>0 guard or the clip: divides
+    by a possibly-zero range (0/0 NaN on a constant score vector) and
+    proves no upper bound at all."""
+    lo = jnp.min(score)
+    hi = jnp.max(score)
+    return (score - lo) * 100.0 / (hi - lo)
+
+
+@jax.jit
+def good_guarded_normalize(score):
+    """The production shape: guarded divisor + clip. Must prove clean —
+    the near-miss that keeps the two bad fixtures honest."""
+    lo = jnp.min(score)
+    hi = jnp.max(score)
+    rng = hi - lo
+    out = jnp.where(rng > 0, (score - lo) * 100.0 / jnp.maximum(rng, 1e-9), 0.0)
+    return jnp.clip(out, 0.0, 100.0)

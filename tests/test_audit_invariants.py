@@ -1,0 +1,215 @@
+"""The jaxpr invariant prover: transfer-rule units, known-violation
+fixtures that MUST fail, and the tier-1 gate that every registered jit
+entry and score plugin proves clean.
+
+(`tests/test_invariants.py` is the engine-level placement-invariant fuzz;
+this file tests `analysis/invariants.py`, the abstract interpreter.)
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from open_simulator_tpu.analysis import invariants as inv
+from open_simulator_tpu.analysis.invariants import (
+    AVal,
+    check_score_plugin,
+    check_traceable,
+    const,
+    from_concrete,
+    inf_any,
+    join,
+    may_zero,
+    top,
+    widen,
+)
+
+F = "f"
+
+
+def _av(lo, hi, **kw):
+    return AVal(float(lo), float(hi), kind=F, **kw)
+
+
+# ---------------------------------------------------------------------------
+# abstract-domain units: one test per load-bearing transfer rule
+# ---------------------------------------------------------------------------
+
+def test_from_concrete_float_flags():
+    a = from_concrete(np.array([1.0, -np.inf, 3.0], dtype=np.float32))
+    assert (a.lo, a.hi) == (1.0, 3.0)
+    assert a.neg_inf and not a.pos_inf and not a.nan
+    assert a.nonzero  # no zero present
+    b = from_concrete(np.array([True, False]))
+    assert (b.lo, b.hi, b.kind) == (0.0, 1.0, "b")
+    assert not b.nonzero
+
+
+def test_add_rule_bounds_and_inf_minus_inf_nan():
+    s = inv._r_add(_av(1, 2), _av(10, 20))
+    assert (s.lo, s.hi) == (11.0, 22.0) and not s.nan
+    mixed = inv._r_sub(
+        _av(0, 0, pos_inf=True), _av(0, 0, pos_inf=True)
+    )
+    assert mixed.nan  # inf - inf
+
+
+def test_mul_rule_flags_the_sentinel_nan():
+    """THE rule the audit exists for: -inf times a may-be-zero factor."""
+    sentinel = _av(-5, 10, neg_inf=True)
+    onehot = _av(0, 1)
+    assert may_zero(onehot) and inf_any(sentinel)
+    out = inv._r_mul(sentinel, onehot)
+    assert out.nan
+    # but a nonzero factor cannot poison
+    safe = inv._r_mul(sentinel, _av(1, 2, nonzero=True))
+    assert not safe.nan and safe.neg_inf
+
+
+def test_mul_rule_finite_bounds():
+    out = inv._r_mul(_av(-2, 3), _av(4, 5))
+    assert (out.lo, out.hi) == (-10.0, 15.0)
+    assert not (out.nan or inf_any(out))
+
+
+def test_div_rule_zero_over_zero_and_bounded_divisor():
+    bad = inv._r_div(_av(0, 1), _av(0, 1))
+    assert bad.nan  # 0/0 reachable
+    ok = inv._r_div(_av(0, 100), _av(1, 4, nonzero=True))
+    assert not ok.nan and (ok.lo, ok.hi) == (0.0, 100.0)
+
+
+def test_minmax_rules_absorb_sentinels():
+    m = inv._r_max(_av(0, 50, neg_inf=True), _av(10, 10, nonzero=True))
+    assert (m.lo, m.hi) == (10.0, 50.0)
+    assert not m.neg_inf  # max with a finite floor absorbs -inf
+    n = inv._r_min(_av(0, 50), _av(0, 0, pos_inf=True))
+    assert n.hi == 50.0 and not n.pos_inf
+
+
+def test_join_and_widen():
+    j = join(_av(0, 1), _av(5, 9, nan=True))
+    assert (j.lo, j.hi) == (0.0, 9.0) and j.nan
+    w = widen(_av(0, 10), _av(0, 11))
+    assert w.hi == float("inf") and not w.pos_inf  # unknown-finite, no flag
+    assert w.lo == 0.0  # stable bound survives
+    assert top("b").hi == 1.0 and not top("b").nan
+    assert const(7).nonzero
+
+
+def test_where_guard_refines_divisor_nonzero():
+    """End-to-end select_n refinement across the pjit _where split: the
+    `where(d == 0, 1, d)` guard proves the division NaN-free."""
+    import jax.numpy as jnp
+
+    def guarded(x):
+        d = jnp.where(x == 0.0, 1.0, x)
+        return 100.0 / d
+
+    rep = check_traceable(
+        "fixture:guarded-div", guarded, (np.array([0.0, 2.0], np.float32),)
+    )
+    assert rep.ok, [f.to_dict() for f in rep.findings]
+
+
+# ---------------------------------------------------------------------------
+# known-violation fixtures: the prover MUST flag these
+# ---------------------------------------------------------------------------
+
+ARGS_SENTINEL = (
+    np.array([5.0, 1.0, 3.0], dtype=np.float32),
+    np.array([True, False, True]),
+)
+
+
+def test_bad_sentinel_select_is_flagged():
+    from tests.fixture_bad_kernels import bad_sentinel_select
+
+    rep = check_traceable(
+        "fixture:bad_sentinel_select", bad_sentinel_select, ARGS_SENTINEL
+    )
+    kinds = sorted({f.kind for f in rep.findings})
+    assert kinds == ["nan-output", "selection-taint"], [
+        f.to_dict() for f in rep.findings
+    ]
+    taint = next(f for f in rep.findings if f.kind == "selection-taint")
+    assert taint.primitive in ("argmax", "argmin")
+
+
+def test_bad_normalize_escapes_score_range():
+    from tests.fixture_bad_kernels import bad_normalize
+
+    rep = check_score_plugin(
+        "fixture:bad_normalize",
+        bad_normalize,
+        (np.array([1.0, 2.0, 3.0], dtype=np.float32),),
+    )
+    assert not rep.ok
+    assert any(f.kind == "score-range" for f in rep.findings)
+    msg = next(f for f in rep.findings if f.kind == "score-range").message
+    assert "NaN" in msg or "escapes" in msg
+
+
+def test_good_guarded_normalize_proves_clean():
+    from tests.fixture_bad_kernels import good_guarded_normalize
+
+    rep = check_score_plugin(
+        "fixture:good_guarded_normalize",
+        good_guarded_normalize,
+        (np.array([1.0, 2.0, 3.0], dtype=np.float32),),
+    )
+    assert rep.ok, [f.to_dict() for f in rep.findings]
+    assert rep.lo >= 0.0 and rep.hi <= 100.0
+
+
+def test_fixture_findings_are_deterministic_json():
+    from tests.fixture_bad_kernels import bad_sentinel_select
+
+    def once():
+        rep = check_traceable(
+            "fixture:bad_sentinel_select", bad_sentinel_select, ARGS_SENTINEL
+        )
+        return json.dumps(rep.to_dict(), sort_keys=True)
+
+    assert once() == once()
+
+
+# ---------------------------------------------------------------------------
+# tier-1 gate: the production kernels prove clean, all 12 entries covered
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def audit():
+    return inv.run_invariants()
+
+
+def test_all_registered_entries_prove_clean(audit):
+    assert audit.ok, audit.render_text()
+    from open_simulator_tpu.analysis.jaxpr_audit import REQUIRED_COVERAGE
+
+    proved = {e.entry for e in audit.entries}
+    assert proved == set(REQUIRED_COVERAGE)
+    assert len(proved) == 12
+
+
+def test_mask_outputs_proved_binary(audit):
+    by_name = {e.entry: e for e in audit.entries}
+    assert by_name["ops.fast:domain_select"].bool_outputs >= 1
+    assert by_name["ops.kernels:probe_step"].bool_outputs >= 1
+
+
+def test_all_score_plugins_prove_range(audit):
+    assert len(audit.plugins) == 10
+    for p in audit.plugins:
+        assert p.ok, p.to_dict()
+        assert p.lo >= 0.0 and p.hi <= 100.0
+        assert "nan" not in p.flags
+
+
+def test_audit_json_shape(audit):
+    doc = audit.to_dict()
+    assert doc["ok"] is True
+    assert [e["entry"] for e in doc["entries"]] == sorted(
+        e["entry"] for e in doc["entries"]
+    )

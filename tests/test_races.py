@@ -1,0 +1,331 @@
+"""Race detector: true-positive fixtures, near-miss negatives, and the
+package-level regression gate.
+
+Fixture packages are written to tmp_path and only parsed — never imported
+or executed — so snippets are free to spawn fake threads and handlers.
+The package-level tests pin the two server.py fixes this detector
+motivated: the _tracemalloc_on check-then-act now runs under
+_tracemalloc_lock, and _live_snapshot carries @guarded_by("_busy").
+"""
+
+import json
+import textwrap
+import threading
+
+from open_simulator_tpu.analysis.races import run_races
+from open_simulator_tpu.analysis.lint import build_context
+
+
+def _races(tmp_path, source, extra_modules=None):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(textwrap.dedent(source))
+    for name, src in (extra_modules or {}).items():
+        (pkg / f"{name}.py").write_text(textwrap.dedent(src))
+    return run_races(package_root=str(pkg), report_root=str(tmp_path))
+
+
+HANDLER_PREAMBLE = """
+    import threading
+    from http.server import BaseHTTPRequestHandler
+
+    _lock = threading.Lock()
+    _cache = {}
+    _hits = 0
+"""
+
+
+# ---------------------------------------------------------------------------
+# true positives
+# ---------------------------------------------------------------------------
+
+def test_unguarded_container_mutation_in_handler_flagged(tmp_path):
+    rep = _races(
+        tmp_path,
+        HANDLER_PREAMBLE + """
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            _cache[self.path] = 1
+    """,
+    )
+    assert [f.access for f in rep.active] == ["mutate"]
+    assert rep.active[0].state == "pkg.mod._cache"
+    assert not rep.ok
+
+
+def test_unguarded_scalar_rmw_flagged(tmp_path):
+    rep = _races(
+        tmp_path,
+        HANDLER_PREAMBLE + """
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            global _hits
+            _hits += 1
+    """,
+    )
+    assert [f.access for f in rep.active] == ["rmw"]
+    assert rep.active[0].state == "pkg.mod._hits"
+
+
+def test_check_then_act_flagged(tmp_path):
+    """A read and a separate rebind in one function is the TOCTOU shape
+    (the _tracemalloc_on bug) even without an AugAssign."""
+    rep = _races(
+        tmp_path,
+        HANDLER_PREAMBLE + """
+    _started = False
+
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            global _started
+            if not _started:
+                _started = True
+    """,
+    )
+    assert [f.access for f in rep.active] == ["check-then-act"]
+    assert rep.active[0].state == "pkg.mod._started"
+
+
+def test_thread_target_and_helper_reachability(tmp_path):
+    """Mutations in a helper function called from a Thread target are
+    reachable and flagged."""
+    rep = _races(
+        tmp_path,
+        """
+    import threading
+
+    _jobs = []
+
+    def _push(x):
+        _jobs.append(x)
+
+    def worker():
+        _push(1)
+
+    def start():
+        threading.Thread(target=worker).start()
+    """,
+    )
+    assert [(f.access, f.state) for f in rep.active] == [
+        ("mutate", "pkg.mod._jobs")
+    ]
+    assert "thread target" in rep.active[0].thread_root
+
+
+def test_signal_handler_is_a_root(tmp_path):
+    rep = _races(
+        tmp_path,
+        """
+    import signal
+
+    _seen = []
+
+    def on_term(signum, frame):
+        _seen.append(signum)
+
+    def install():
+        signal.signal(signal.SIGTERM, on_term)
+    """,
+    )
+    assert [f.state for f in rep.active] == ["pkg.mod._seen"]
+    assert "signal handler" in rep.active[0].thread_root
+
+
+def test_cross_module_shared_state(tmp_path):
+    """A handler mutating another module's shared dict through a module
+    import is resolved to the owning module."""
+    rep = _races(
+        tmp_path,
+        """
+    from http.server import BaseHTTPRequestHandler
+    from . import store
+
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            store.table["k"] = 1
+    """,
+        extra_modules={"store": "table = {}\n"},
+    )
+    assert [f.state for f in rep.active] == ["pkg.store.table"]
+
+
+# ---------------------------------------------------------------------------
+# near-miss negatives
+# ---------------------------------------------------------------------------
+
+def test_with_lock_dominated_mutation_ok(tmp_path):
+    rep = _races(
+        tmp_path,
+        HANDLER_PREAMBLE + """
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            with _lock:
+                _cache[self.path] = 1
+    """,
+    )
+    assert rep.ok, rep.render_text()
+
+
+def test_guarded_by_annotation_trusted(tmp_path):
+    """@guarded_by asserts the caller holds the lock (e.g. a non-with
+    acquire like server.py's do_POST) — the body is treated as dominated."""
+    rep = _races(
+        tmp_path,
+        HANDLER_PREAMBLE + """
+    from pkg.conc import guarded_by
+
+    @guarded_by("_lock")
+    def refresh(k):
+        global _hits
+        _hits = _hits + 1
+        _cache[k] = _hits
+
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            with _lock:
+                refresh(self.path)
+    """,
+        extra_modules={
+            "conc": "def guarded_by(name):\n    return lambda fn: fn\n"
+        },
+    )
+    assert rep.ok, rep.render_text()
+
+
+def test_plain_publish_not_flagged(tmp_path):
+    """A single rebind with no read in the same function is an atomic
+    publish under the GIL — the serve()-resets-the-snapshot shape."""
+    rep = _races(
+        tmp_path,
+        HANDLER_PREAMBLE + """
+    _snapshot = None
+
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            global _snapshot
+            _snapshot = None
+    """,
+    )
+    assert rep.ok, rep.render_text()
+
+
+def test_pure_reads_not_flagged(tmp_path):
+    rep = _races(
+        tmp_path,
+        HANDLER_PREAMBLE + """
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            x = _cache.get(self.path)
+            y = _hits
+            return (x, y)
+    """,
+    )
+    assert rep.ok, rep.render_text()
+
+
+def test_unreachable_mutation_not_flagged(tmp_path):
+    """No thread roots in the package => nothing is audited."""
+    rep = _races(
+        tmp_path,
+        """
+    _cache = {}
+
+    def mutate():
+        _cache["k"] = 1
+    """,
+    )
+    assert rep.ok
+    assert rep.audited_functions == 0
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+def test_audit_ok_suppression_and_staleness(tmp_path):
+    src = HANDLER_PREAMBLE + """
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            _cache[self.path] = 1  # osim: audit-ok[race]
+            x = 1  # osim: audit-ok[race]
+    """
+    rep = _races(tmp_path, src)
+    assert rep.active == []
+    assert [f.suppressed for f in rep.findings] == [True]
+    # the second comment suppresses nothing -> stale, and ok stays False
+    assert [(u.line, u.rule) for u in rep.unused_suppressions] == [
+        (rep.findings[0].line + 1, "race")
+    ]
+    assert not rep.ok
+
+
+def test_report_json_is_deterministic(tmp_path):
+    src = HANDLER_PREAMBLE + """
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            global _hits
+            _hits += 1
+            _cache[self.path] = 1
+    """
+    a = json.dumps(_races(tmp_path, src).to_dict(), sort_keys=True)
+    b = json.dumps(_races(tmp_path, src).to_dict(), sort_keys=True)
+    assert a == b
+    doc = json.loads(a)
+    assert [f["access"] for f in doc["findings"]] == ["rmw", "mutate"]
+
+
+# ---------------------------------------------------------------------------
+# package-level regression gate + the fixed server.py bugs
+# ---------------------------------------------------------------------------
+
+def test_installed_package_has_no_unguarded_races():
+    rep = run_races()
+    assert rep.ok, rep.render_text()
+    # the audit actually looked at the threaded surface
+    assert rep.audited_functions > 0
+    assert any("do_POST" in r or "do_GET" in r for r in rep.thread_roots)
+
+
+def test_known_good_guarded_modules_not_flagged():
+    """policy.py's _breakers and tracing's history are with-lock guarded;
+    they must appear as shared state yet produce no findings."""
+    rep = run_races()
+    assert any("policy._breakers" in s for s in rep.shared_objects)
+    assert not [f for f in rep.findings if "policy" in f.state]
+
+
+def test_heap_profile_check_then_act_is_serialized():
+    """Regression for the _tracemalloc_on race: concurrent heap profiles
+    must agree that exactly one of them started tracing."""
+    from open_simulator_tpu.server import server
+
+    server._tracemalloc_on = False
+    results = []
+    barrier = threading.Barrier(4)
+
+    def go():
+        barrier.wait()
+        results.append(server._heap_profile()["note"] != "")
+
+    threads = [threading.Thread(target=go) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sum(results) == 1, results
+
+
+def test_live_snapshot_declares_its_lock():
+    from open_simulator_tpu.server import server
+    from open_simulator_tpu.utils.concurrency import GUARDED_BY_ATTR
+
+    assert getattr(server._live_snapshot, GUARDED_BY_ATTR) == "_busy"
+
+
+def test_build_context_reuse_matches_fresh_run():
+    """run_races accepts a prebuilt context (the audit driver path)."""
+    ctx = build_context()
+    a = run_races(ctx=ctx).to_dict()
+    b = run_races().to_dict()
+    assert a == b
