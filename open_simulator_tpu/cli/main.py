@@ -57,6 +57,132 @@ def _add_apply(sub: argparse._SubParsersAction) -> None:
         help="after the run, write the scheduler metrics snapshot "
         "(counters/histograms, see docs/observability.md) as JSON here",
     )
+    p.add_argument(
+        "--run-dir", default="",
+        help="journal the run into this directory (durable checkpoint: "
+        "every capacity trial is committed as it completes, see "
+        "docs/durability.md)",
+    )
+    p.add_argument(
+        "--resume", nargs="?", const=True, default=False, metavar="RUN_DIR",
+        help="resume a journaled run: completed trials replay from the "
+        "journal instead of re-simulating (RUN_DIR defaults to --run-dir)",
+    )
+
+
+def _add_runs(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "runs",
+        help="list, inspect, and resume journaled runs",
+        description=(
+            "Operate on durable run journals (docs/durability.md). "
+            "`list` shows every journaled run under the runs root "
+            "(OSIM_RUNS_DIR or ~/.cache/open-simulator-tpu/runs, or --root); "
+            "`show` prints one run's summary and journal; `resume` re-runs "
+            "an interrupted apply from its journal, re-simulating only "
+            "trials the crashed run never committed."
+        ),
+    )
+    p.add_argument(
+        "action", choices=("list", "show", "resume"),
+        help="list all runs / show one run / resume an interrupted apply",
+    )
+    p.add_argument(
+        "run_dir", nargs="?", default="",
+        help="run directory (required for show/resume)",
+    )
+    p.add_argument(
+        "--root", default="",
+        help="runs root for `list` (default: OSIM_RUNS_DIR or "
+        "~/.cache/open-simulator-tpu/runs)",
+    )
+    p.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output format",
+    )
+    p.add_argument(
+        "-f", "--simon-config", default="",
+        help="resume: config path override (default: the journaled one)",
+    )
+
+
+def _run_runs(args) -> int:
+    import json as _json
+
+    from ..durable import default_runs_root, list_runs, replay, summarize_run
+
+    if args.action == "list":
+        rows = list_runs(args.root or default_runs_root())
+        if args.format == "json":
+            print(_json.dumps(rows, indent=2, sort_keys=True))
+            return 0
+        if not rows:
+            print(f"no journaled runs under {args.root or default_runs_root()}")
+            return 0
+        hdr = f"{'RUN':<28} {'KIND':<6} {'STATUS':<18} {'TRIALS':>6} {'SEGS':>4} {'DEVICE':<14} PATH"
+        print(hdr)
+        for r in rows:
+            flag = " (cpu-fallback)" if r["fallback"] == "cpu" else ""
+            print(
+                f"{r['name']:<28} {r['kind']:<6} {r['status']:<18} "
+                f"{r['trials']:>6} {r['segments']:>4} "
+                f"{(r['device'] or '?'):<14} {r['run_dir']}{flag}"
+            )
+        return 0
+
+    if not args.run_dir:
+        print(f"error: `runs {args.action}` needs a run directory", file=sys.stderr)
+        return 1
+    summary = summarize_run(args.run_dir)
+    if not summary["events"]:
+        print(f"error: no journal found in {args.run_dir}", file=sys.stderr)
+        return 1
+
+    if args.action == "show":
+        events = replay(args.run_dir)
+        if args.format == "json":
+            print(_json.dumps({"summary": summary, "events": events},
+                              indent=2, sort_keys=True))
+            return 0
+        for k in ("run_dir", "kind", "config", "status", "outcome", "device",
+                  "fallback", "events", "trials", "segments", "resumes",
+                  "watchdogs"):
+            print(f"{k:>10}: {summary[k]}")
+        print("journal:")
+        for e in events:
+            extra = {k: v for k, v in e.items() if k not in ("seq", "ts", "event")}
+            print(f"  [{e['seq']:>4}] {e['event']:<18} {_json.dumps(extra, sort_keys=True)}")
+        return 0
+
+    # resume: only apply runs are resumable from the CLI (bench has its own
+    # entry point: `python bench.py --resume RUN_DIR`)
+    if summary["kind"] != "apply":
+        print(
+            f"error: run {args.run_dir} is kind={summary['kind'] or '?'}; "
+            "`simon runs resume` handles apply runs — resume bench runs "
+            "with `python bench.py --resume RUN_DIR`",
+            file=sys.stderr,
+        )
+        return 1
+    config_path = args.simon_config or summary["config"]
+    if not config_path:
+        print(
+            "error: the journal records no config path; pass -f/--simon-config",
+            file=sys.stderr,
+        )
+        return 1
+    from ..api.config import SimonConfig
+    from ..engine.apply import ApplyError, run_apply
+
+    try:
+        cfg = SimonConfig.load(config_path)
+        outcome = run_apply(
+            cfg, run_dir=args.run_dir, resume=True, config_path=config_path
+        )
+    except (ApplyError, ValueError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    return 0 if not outcome.result.unscheduled else 2
 
 
 def _add_lint(sub: argparse._SubParsersAction) -> None:
@@ -331,6 +457,7 @@ def main(argv=None) -> int:
     _add_audit(sub)
     _add_chaos(sub)
     _add_lint(sub)
+    _add_runs(sub)
     ps = sub.add_parser(
         "server", help="run the REST simulation service",
         description="run the REST simulation service",
@@ -356,18 +483,34 @@ def main(argv=None) -> int:
     pd.add_argument("--output-dir", default="./docs/commandline")
 
     args = parser.parse_args(argv)
-    if args.command in ("apply", "chaos", "server"):
+    if args.command in ("apply", "chaos", "server", "runs"):
         from ..utils.platform import enable_compilation_cache, ensure_platform
         from ..utils.tracing import init_logging
 
         init_logging()  # LogLevel env, parity: cmd/simon/simon.go:46-66
         ensure_platform()
         enable_compilation_cache()
+    if args.command in ("apply", "server", "runs"):
+        # honor OSIM_FAULT_PLAN for non-chaos entry points too (chaos does
+        # its own install): docs/resilience.md promises env-driven plans,
+        # and the crash-resume smoke injects its deterministic SIGKILL into
+        # a plain `simon apply` this way
+        from ..resilience import faults
+
+        try:
+            plan = faults.FaultPlan.from_env()
+        except faults.FaultInjectionError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        if plan is not None:
+            faults.install_plan(plan)
     if args.command == "version":
         print(f"simon-tpu version {VERSION}")
         return 0
     if args.command == "chaos":
         return _run_chaos(args)
+    if args.command == "runs":
+        return _run_runs(args)
     if args.command == "audit":
         return _run_audit(args)
     if args.command == "lint":
@@ -397,6 +540,13 @@ def main(argv=None) -> int:
                         f"--extended-resources: unknown resource(s) "
                         f"{sorted(unknown)}; expected gpu, open-local"
                     )
+                run_dir = args.run_dir or (
+                    args.resume if isinstance(args.resume, str) else ""
+                )
+                if args.resume and not run_dir:
+                    raise ApplyError(
+                        "--resume needs a run dir (inline or --run-dir)"
+                    )
                 outcome = run_apply(
                     cfg,
                     interactive=args.interactive,
@@ -406,6 +556,9 @@ def main(argv=None) -> int:
                     use_greed=args.use_greed,
                     devices=args.devices,
                     extended_resources=ext,
+                    run_dir=run_dir,
+                    resume=bool(args.resume),
+                    config_path=args.simon_config,
                 )
             finally:
                 if out is not None:

@@ -1,0 +1,227 @@
+"""Watchdogs: hard deadlines around the calls that have actually hung.
+
+Every bench round since r03 wedged the same way: backend init against the
+TPU tunnel blocked forever, the process sat silent, and the round was
+eventually killed by a human — losing every completed trial and (worse)
+sometimes banking a CPU capture under a TPU label. The fix is the classic
+host-side watchdog: run the blocking call in a worker thread, poll a
+monotonic clock, and when the deadline passes raise `DeadlineExceeded` in
+the *caller* so the run can degrade deliberately instead of hanging.
+
+Two deadlines, both env-tunable (see docs/durability.md):
+
+    OSIM_BACKEND_DEADLINE_S  (default 90)  backend acquisition / first
+                                           device contact
+    OSIM_CALL_DEADLINE_S     (default 0)   any guarded compile/execute
+                                           call; 0 disables
+
+`acquire_backend` is the degradation ladder in code form:
+
+    probe backend under deadline
+      └─ timeout/error → journal `backend_retry`, warm the persistent
+         compile cache, probe once more under a fresh deadline
+           └─ timeout/error → pin JAX_PLATFORMS=cpu (jax.config.update,
+              authoritative over the site hook), journal
+              `backend_fallback`, stamp device/fallback/fallback_reason
+
+The stamped dict is what bench/apply merge as *top-level* output fields —
+the honest-provenance contract that kills the silent-mislabel class
+(ADVICE.md): a CPU-fallback result can no longer masquerade as TPU.
+
+Caveat shared by every host-side watchdog: an abandoned worker thread may
+still hold the GIL-released blocking call (XLA compile, RPC). We cannot
+kill it — we *can* stop waiting, record the timeout durably, and hand the
+run a working (CPU) backend. The daemon flag keeps the zombie from
+blocking interpreter exit.
+
+Tests inject `clock`/`poll_s` (and a fake probe) so deadline behavior is
+provable without sleeping — same idiom as resilience/policy.py.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..resilience import faults
+from ..utils import metrics
+from ..utils.platform import enable_compilation_cache, ensure_platform
+from ..utils.tracing import log, span
+
+DEFAULT_BACKEND_DEADLINE_S = 90.0
+
+
+class DeadlineExceeded(Exception):
+    """A guarded call outlived its deadline. The worker may still be
+    running (blocking native code is unkillable from the host); the caller
+    must treat the backend/call as lost and degrade."""
+
+    def __init__(self, stage: str, deadline_s: float) -> None:
+        super().__init__(f"{stage} exceeded {deadline_s:g}s deadline")
+        self.stage = stage
+        self.deadline_s = deadline_s
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        log.warning("%s=%r is not a number; using %g", name, raw, default)
+        return default
+
+
+def backend_deadline_s() -> float:
+    return _env_float("OSIM_BACKEND_DEADLINE_S", DEFAULT_BACKEND_DEADLINE_S)
+
+
+def call_deadline_s() -> float:
+    """Deadline for guarded compile/execute calls; 0 = watchdog off."""
+    return _env_float("OSIM_CALL_DEADLINE_S", 0.0)
+
+
+def guarded_call(
+    stage: str,
+    fn: Callable[[], Any],
+    deadline_s: float,
+    *,
+    clock: Callable[[], float] = time.monotonic,
+    poll_s: float = 0.25,
+    journal: Any = None,
+) -> Any:
+    """Run `fn()` in a watchdog-guarded worker; raise DeadlineExceeded if it
+    doesn't finish within `deadline_s` (<=0 runs `fn` inline, unguarded).
+
+    The heartbeat is the poll loop itself: the host wakes every `poll_s`,
+    re-reads the clock, and decides liveness — so a wedged native call
+    can't take the supervising thread down with it."""
+    if deadline_s <= 0:
+        return fn()
+
+    result: List[Any] = []
+    error: List[BaseException] = []
+    done = threading.Event()
+
+    def _worker() -> None:
+        try:
+            result.append(fn())
+        except BaseException as e:  # noqa: B036 - must forward KeyboardInterrupt etc.
+            error.append(e)
+        finally:
+            done.set()
+
+    with span("watchdog", stage=stage, deadline_s=deadline_s):
+        t = threading.Thread(target=_worker, name=f"osim-guarded-{stage}", daemon=True)
+        start = clock()
+        t.start()
+        while not done.is_set():
+            remaining = deadline_s - (clock() - start)
+            if remaining <= 0 and not done.is_set():
+                metrics.WATCHDOG_FIRED.inc(stage=stage)
+                log.error("watchdog: %s exceeded %gs deadline", stage, deadline_s)
+                if journal is not None:
+                    journal.append("watchdog", stage=stage, deadline_s=deadline_s)
+                raise DeadlineExceeded(stage, deadline_s)
+            done.wait(min(poll_s, max(remaining, 0.001)))
+    if error:
+        raise error[0]
+    return result[0]
+
+
+# ---------------------------------------------------------------------------
+# Backend acquisition ladder.
+# ---------------------------------------------------------------------------
+
+def _default_probe() -> str:
+    """First device contact: honor JAX_PLATFORMS, touch a device, return its
+    name. This is exactly the call that wedged rounds r03–r05, so it is the
+    fault-injection point for backend hangs (target=backend, op=acquire)."""
+    rule = faults.maybe_inject("backend", "acquire")
+    if rule is not None:
+        faults.apply_backend_fault(rule)
+    ensure_platform()
+    import jax
+    import jax.numpy as jnp
+
+    jnp.zeros(4).block_until_ready()
+    return str(jax.devices()[0])
+
+
+def acquire_backend(
+    deadline_s: Optional[float] = None,
+    journal: Any = None,
+    *,
+    probe: Optional[Callable[[], str]] = None,
+    clock: Callable[[], float] = time.monotonic,
+    poll_s: float = 0.25,
+) -> Dict[str, Any]:
+    """Acquire a working JAX backend under a hard deadline, degrading
+    TPU→CPU rather than hanging or lying.
+
+    Returns a provenance dict — `{"device": ...}` plus, after degradation,
+    `{"fallback": "cpu", "fallback_reason": ...}` — that callers must merge
+    as TOP-LEVEL fields of their output JSON."""
+    if deadline_s is None:
+        deadline_s = backend_deadline_s()
+    probe_fn = probe or _default_probe
+    info: Dict[str, Any] = {}
+
+    def _try(stage: str) -> str:
+        return guarded_call(
+            stage, probe_fn, deadline_s, clock=clock, poll_s=poll_s, journal=journal
+        )
+
+    try:
+        device = _try("backend-acquire")
+        info["device"] = device
+        if journal is not None:
+            journal.append("backend", device=device)
+        return info
+    except Exception as first_err:  # DeadlineExceeded or a real probe error
+        # One journaled retry from the persistent compile cache: warm-cache
+        # init skips the compile window that eats most of the deadline
+        # (76 s compile in BENCH_r02).
+        cache_dir = enable_compilation_cache()
+        if journal is not None:
+            journal.append(
+                "backend_retry",
+                error=str(first_err),
+                compile_cache=str(cache_dir or ""),
+            )
+        log.warning(
+            "backend acquisition failed (%s); retrying once with persistent "
+            "compile cache", first_err,
+        )
+        try:
+            device = _try("backend-retry")
+            info["device"] = device
+            if journal is not None:
+                journal.append("backend", device=device, retried=True)
+            return info
+        except Exception as second_err:
+            reason = (
+                f"backend acquisition timed out/failed twice: "
+                f"{first_err}; retry: {second_err}"
+            )
+            log.error("degrading to CPU: %s", reason)
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            try:
+                import jax
+
+                jax.config.update("jax_platforms", "cpu")
+                device = str(jax.devices()[0])
+            except Exception as cpu_err:
+                raise RuntimeError(
+                    f"CPU fallback failed after: {reason} ({cpu_err})"
+                )
+            info.update(device=device, fallback="cpu", fallback_reason=reason)
+            if journal is not None:
+                journal.append(
+                    "backend_fallback", device=device, fallback="cpu",
+                    fallback_reason=reason,
+                )
+            return info

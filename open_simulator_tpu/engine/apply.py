@@ -10,6 +10,7 @@ search (engine/capacity.py) with interactive kept as an option.
 
 from __future__ import annotations
 
+import os
 import shutil
 import subprocess
 from dataclasses import dataclass, field as dataclass_field
@@ -153,6 +154,50 @@ class ApplyOutcome:
     plan: Optional[CapacityPlan] = None
     report: str = ""
     failed_apps: List[FailedApp] = dataclass_field(default_factory=list)
+    # Honest device provenance (durable/watchdog.py ladder): which backend
+    # actually ran the simulation, and — when the run degraded — why. These
+    # are stamped as TOP-LEVEL fields of every serialized outcome so a
+    # CPU-fallback run can never masquerade as a TPU capture.
+    device: str = ""
+    fallback: str = ""
+    fallback_reason: str = ""
+
+
+def placement_digest(result: SimulateResult) -> str:
+    """Stable digest of the workload→node assignment. Two runs produced the
+    same plan iff their digests match — the byte-identity check the
+    crash-resume smoke uses (timestamps and attempt counts live elsewhere).
+
+    Keyed by (workload kind/ns/name, node, replica count), NOT pod name:
+    expanded pod names draw suffixes from the process-global seeded RNG
+    (core/workloads.py), whose draw sequence depends on how many expansions
+    ran — a resumed run skips most of them, so names differ while the plan
+    (interchangeable replicas per workload per node) is identical."""
+    import hashlib
+
+    from ..core.objects import (
+        ANNO_WORKLOAD_KIND,
+        ANNO_WORKLOAD_NAME,
+        ANNO_WORKLOAD_NAMESPACE,
+    )
+
+    counts: dict = {}
+    for st in result.node_status:
+        for p in st.pods:
+            ann = p.meta.annotations
+            wl = (
+                ann.get(ANNO_WORKLOAD_KIND, ""),
+                ann.get(ANNO_WORKLOAD_NAMESPACE, p.meta.namespace),
+                # standalone pods carry no workload annotation; their
+                # manifest name is already deterministic
+                ann.get(ANNO_WORKLOAD_NAME) or p.meta.name,
+                st.node.name,
+            )
+            counts[wl] = counts.get(wl, 0) + 1
+    blob = "\n".join(
+        "\t".join(k) + f"\t{n}" for k, n in sorted(counts.items())
+    )
+    return hashlib.blake2b(blob.encode(), digest_size=8).hexdigest()
 
 
 def select_apps(
@@ -198,9 +243,26 @@ def run_apply(
     use_greed: bool = False,
     devices: int = 1,
     extended_resources: Optional[List[str]] = None,
+    run_dir: Optional[str] = None,
+    resume: bool = False,
+    config_path: str = "",
 ) -> ApplyOutcome:
+    """With `run_dir`, the run is journaled (durable/journal.py): backend
+    acquisition, every capacity trial, and the final outcome are committed
+    as they happen, and `resume=True` replays the journal so a crashed run
+    re-simulates only what it never finished. Without `run_dir` the run is
+    un-journaled but still acquires its backend through the watchdog ladder
+    and stamps honest device provenance on the outcome."""
     import sys
 
+    from ..durable import (
+        DeadlineExceeded,
+        RunJournal,
+        acquire_backend,
+        atomic_write,
+        call_deadline_s,
+        guarded_call,
+    )
     from ..models.profiles import load_scheduler_config
 
     from ..utils.tracing import span
@@ -210,6 +272,21 @@ def run_apply(
     # Interactive prompts must stay visible on the terminal even when the
     # report is routed to --output-file.
     ui_out = sys.stderr if report_to_file else out
+
+    journal: Optional[RunJournal] = None
+    if run_dir:
+        journal = RunJournal.open(run_dir)
+        if not journal.has("run_start"):
+            journal.append(
+                "run_start", kind="apply", name=cfg.name,
+                simon_config=config_path,
+            )
+        if resume:
+            metrics.RUN_RESUMED.inc()
+            journal.append("run_resume")
+
+    with span("backend-acquire"):
+        backend = acquire_backend(journal=journal)
     with span("build-cluster"):
         cluster = build_cluster(cfg)
     failed_apps: List[FailedApp] = []
@@ -232,44 +309,81 @@ def run_apply(
 
         mesh = product_mesh(devices)
 
-    result = simulate(
-        cluster, apps, profiles=profiles, use_greed=use_greed, mesh=mesh,
-        extenders=extenders,
-    )
-    plan: Optional[CapacityPlan] = None
+    def _simulate_and_plan(resume_now: bool):
+        result = guarded_call(
+            "apply-simulate",
+            lambda: simulate(
+                cluster, apps, profiles=profiles, use_greed=use_greed,
+                mesh=mesh, extenders=extenders,
+            ),
+            call_deadline_s(),
+        )
+        plan: Optional[CapacityPlan] = None
 
-    if result.unscheduled and new_node is not None:
-        if interactive:
-            result = _interactive_loop(
-                cluster, apps, new_node, result, ui_out, input_fn,
-                profiles=profiles, use_greed=use_greed, mesh=mesh,
-                extenders=extenders,
-            )
-        elif auto_plan:
-            print(
-                f"{len(result.unscheduled)} pod(s) unschedulable; searching for "
-                f"minimum copies of node {new_node.name}...",
-                file=out,
-            )
-            with span("capacity-search"):
-                plan = plan_capacity(
-                    cluster, apps, new_node, profiles=profiles,
-                    use_greed=use_greed, mesh=mesh, extenders=extenders,
+        if result.unscheduled and new_node is not None:
+            if interactive:
+                result = _interactive_loop(
+                    cluster, apps, new_node, result, ui_out, input_fn,
+                    profiles=profiles, use_greed=use_greed, mesh=mesh,
+                    extenders=extenders,
                 )
-            if plan is None:
-                print("capacity search failed: workload does not fit", file=out)
-            else:
-                degraded = (
-                    f", {plan.retries} retried on transient extender errors"
-                    if plan.retries
-                    else ""
-                )
+            elif auto_plan:
                 print(
-                    f"capacity plan: add {plan.nodes_added} x {new_node.name} "
-                    f"({plan.attempts} simulations{degraded})",
+                    f"{len(result.unscheduled)} pod(s) unschedulable; "
+                    f"searching for minimum copies of node "
+                    f"{new_node.name}...",
                     file=out,
                 )
-                result = plan.result
+                with span("capacity-search"):
+                    plan = plan_capacity(
+                        cluster, apps, new_node, profiles=profiles,
+                        use_greed=use_greed, mesh=mesh, extenders=extenders,
+                        journal=journal, resume=resume_now,
+                    )
+                if plan is None:
+                    print(
+                        "capacity search failed: workload does not fit",
+                        file=out,
+                    )
+                else:
+                    degraded = (
+                        f", {plan.retries} retried on transient extender "
+                        "errors"
+                        if plan.retries
+                        else ""
+                    )
+                    print(
+                        f"capacity plan: add {plan.nodes_added} x "
+                        f"{new_node.name} "
+                        f"({plan.attempts} simulations{degraded})",
+                        file=out,
+                    )
+                    result = plan.result
+        return result, plan
+
+    try:
+        result, plan = _simulate_and_plan(resume)
+    except DeadlineExceeded as e:
+        # A guarded device call wedged mid-run (the r03–r05 failure mode,
+        # post-acquisition flavor). Degrade to CPU explicitly, stamp the
+        # provenance, and retry once — resuming from the journal so trials
+        # the wedged attempt already committed are not re-simulated.
+        reason = f"guarded call wedged mid-run: {e}"
+        print(f"watchdog: {e}; degrading to CPU and retrying", file=ui_out)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        backend.update(
+            device=str(jax.devices()[0]), fallback="cpu",
+            fallback_reason=reason,
+        )
+        if journal is not None:
+            journal.append(
+                "backend_fallback", device=backend["device"], fallback="cpu",
+                fallback_reason=reason,
+            )
+        result, plan = _simulate_and_plan(journal is not None)
 
     with span("render-report"):
         report = full_report(result, extended_resources=extended_resources)
@@ -291,8 +405,50 @@ def run_apply(
 
         display = colorize_report(report)
     print(display, file=out)
+    device_line = f"device: {backend.get('device', '')}"
+    if backend.get("fallback"):
+        device_line += (
+            f" (fallback={backend['fallback']}: {backend['fallback_reason']})"
+        )
+    print(device_line, file=out)
+
+    digest = placement_digest(result)
+    if journal is not None:
+        import json as _json
+
+        journal.append(
+            "run_end", outcome=outcome,
+            nodes_added=(plan.nodes_added if plan else 0), digest=digest,
+        )
+        # whole-file snapshot for `simon runs show` / the crash-resume smoke:
+        # deliberately timestamp-free so interrupted+resumed and
+        # uninterrupted runs produce byte-identical files
+        atomic_write(
+            os.path.join(journal.run_dir, "outcome.json"),
+            _json.dumps(
+                {
+                    "outcome": outcome,
+                    "device": backend.get("device", ""),
+                    "fallback": backend.get("fallback", ""),
+                    "fallback_reason": backend.get("fallback_reason", ""),
+                    "nodes_added": plan.nodes_added if plan else 0,
+                    "attempts": plan.attempts if plan else 0,
+                    "retries": plan.retries if plan else 0,
+                    "unscheduled": len(result.unscheduled),
+                    "failed_apps": [fa.name for fa in failed_apps],
+                    "placement_digest": digest,
+                },
+                indent=2,
+                sort_keys=True,
+            )
+            + "\n",
+        )
+        journal.close()
     return ApplyOutcome(
-        result=result, plan=plan, report=report, failed_apps=failed_apps
+        result=result, plan=plan, report=report, failed_apps=failed_apps,
+        device=backend.get("device", ""),
+        fallback=backend.get("fallback", ""),
+        fallback_reason=backend.get("fallback_reason", ""),
     )
 
 

@@ -113,6 +113,8 @@ def _probe(
     expand_cache: Optional[dict] = None,
     extenders=None,
 ) -> SimulateResult:
+    from ..durable.watchdog import call_deadline_s, guarded_call
+
     trial = ClusterResource(
         nodes=list(cluster.nodes) + new_fake_nodes(template, k),
         pods=list(cluster.pods),
@@ -121,10 +123,17 @@ def _probe(
     )
     metrics.CAPACITY_PROBES.inc()
     with span("capacity-probe", nodes_added=k):
-        return simulate(
-            trial, apps, weights=weights, use_greed=use_greed, mesh=mesh,
-            n_pad=n_pad, profiles=profiles, expand_cache=expand_cache,
-            extenders=extenders,
+        # OSIM_CALL_DEADLINE_S>0 puts a host-side watchdog around the
+        # blocking compile/execute (a wedged device call raises
+        # DeadlineExceeded instead of hanging the sweep); 0 runs inline.
+        return guarded_call(
+            "capacity-probe",
+            lambda: simulate(
+                trial, apps, weights=weights, use_greed=use_greed, mesh=mesh,
+                n_pad=n_pad, profiles=profiles, expand_cache=expand_cache,
+                extenders=extenders,
+            ),
+            call_deadline_s(),
         )
 
 
@@ -159,9 +168,22 @@ def plan_capacity(
     mesh=None,
     profiles=None,
     extenders=None,
+    journal=None,
+    resume: bool = False,
 ) -> Optional[CapacityPlan]:
     """Minimum clones of `new_node` so every pod schedules and utilization
-    gates pass. Returns None if even max_new_nodes doesn't suffice."""
+    gates pass. Returns None if even max_new_nodes doesn't suffice.
+
+    Durability: with a `journal` (durable.RunJournal), every trial's verdict
+    is committed as a `trial` record *after* it completes, and with
+    `resume=True` previously-journaled verdicts are consumed (FIFO per node
+    count — the search order is deterministic given the same verdicts, so
+    records replay in the order they were produced) instead of re-running
+    the probe. A resumed run therefore re-simulates only trials the crashed
+    run never finished, plus one `final` materializing replay — which is
+    journaled as `final`, not `trial`, and never counted in
+    `CapacityPlan.attempts`, so attempts/retries are identical between an
+    interrupted+resumed sweep and an uninterrupted one."""
 
     from ..ops.encode import round_up
     from ..resilience.policy import RetryExhaustedError, RetryPolicy
@@ -179,15 +201,31 @@ def plan_capacity(
     # for a transport timeout would mis-size the cluster.
     trial_policy = RetryPolicy.from_env(max_attempts=2)
 
+    # node_count -> FIFO of journaled trial records from the crashed run(s)
+    resume_cache: dict = {}
+    if resume and journal is not None:
+        for e in journal.events("trial"):
+            resume_cache.setdefault(int(e["node_count"]), []).append(e)
+
+    # seed for the exponential phase's first hi (demand/supply estimate);
+    # journaled with the base trial so a resume never needs the base result
+    seed_hi: Optional[int] = None
+    # result of the most recent LIVE simulate — the only result whose pod
+    # bindings are current (probes share cached pod objects; see finalize)
+    last_live: Optional[SimulateResult] = None
+
     def good(res: SimulateResult) -> bool:
         return not res.unscheduled and satisfy_resource_setting(res)
 
-    def probe(k: int, n_pad: Optional[int] = None) -> SimulateResult:
-        nonlocal attempts, retries
+    def run_trial(k: int, n_pad: Optional[int]):
+        """One live probe with transient-blip retry. Returns
+        (result, attempts_this_trial, retries_this_trial)."""
+        t_attempts = 0
+        t_retries = 0
 
         def once(_timeout: Optional[float]) -> SimulateResult:
-            nonlocal attempts
-            attempts += 1
+            nonlocal t_attempts
+            t_attempts += 1
             res = _probe(
                 cluster, apps, new_node, k, weights, use_greed, mesh,
                 n_pad=n_pad, profiles=profiles, expand_cache=expand_cache,
@@ -199,25 +237,96 @@ def plan_capacity(
             return res
 
         def note(_attempt: int, exc: BaseException, _delay: float) -> None:
-            nonlocal retries
-            retries += 1
+            nonlocal t_retries
+            t_retries += 1
             log.warning(
                 "capacity probe (%d nodes) hit a transient extender failure "
                 "(%s); retrying trial", k, exc,
             )
 
         try:
-            return trial_policy.execute(
+            res = trial_policy.execute(
                 once, retryable=(_TransientTrialError,),
                 target="capacity-probe", on_retry=note,
             )
         except RetryExhaustedError as e:
             # the retry blipped too — return the degraded result honestly
             # (its unscheduled list carries the extender error as the reason)
-            return e.last_exc.result  # type: ignore[union-attr]
+            res = e.last_exc.result  # type: ignore[union-attr]
+        return res, t_attempts, t_retries
 
-    base = probe(0)
-    if good(base):
+    def probe(k: int, n_pad: Optional[int] = None):
+        """One committed trial: journaled verdict, or a cache hit on resume.
+        Returns (good, result-or-None) — None when the verdict came from the
+        journal (no live simulation ran, so there is no result object)."""
+        nonlocal attempts, retries, seed_hi, last_live
+        pending = resume_cache.get(k)
+        if pending:
+            e = pending.pop(0)
+            if not pending:
+                resume_cache.pop(k, None)
+            attempts += int(e.get("attempt", 1))
+            retries += int(e.get("retries", 0))
+            if k == 0 and e.get("seed_hi") is not None:
+                seed_hi = int(e["seed_hi"])
+            return bool(e.get("good")), None
+        res, t_attempts, t_retries = run_trial(k, n_pad)
+        attempts += t_attempts
+        retries += t_retries
+        last_live = res
+        g = good(res)
+        payload = dict(node_count=k, good=g, attempt=t_attempts,
+                       retries=t_retries)
+        if k == 0 and not g:
+            seed_hi = max(min(lower_bound_nodes(res, new_node),
+                              max_new_nodes), 1)
+            payload["seed_hi"] = seed_hi
+        if journal is not None:
+            journal.append("trial", **payload)
+        return g, res
+
+    def finalize(k: int, n_pad: Optional[int]) -> SimulateResult:
+        """Materializing replay of the winning count. Probes share cached
+        pod objects and every probe rebinds them, so only the LAST live
+        simulate's result carries true bindings — when the winner isn't it
+        (or the winner's verdict came from the journal), replay once. Same
+        executables, so this is one cheap run; journaled as `final`, not
+        `trial`, and excluded from attempts/retries so plans are
+        byte-identical across interrupted/uninterrupted runs.
+
+        The replay's correctness rests on run-to-run determinism of
+        simulate (e.g. DaemonSet pods re-expand with fresh RNG-suffixed
+        names, which must never influence placement) — the same property
+        journal-based resume rests on. One cheap re-check turns any future
+        nondeterminism into a loud error instead of a silently-wrong
+        CapacityPlan. HTTP extenders are legitimately non-reproducible
+        (stateful endpoints, transient timeouts on ignorable extenders), so
+        with extenders configured a mismatch is attributed and tolerated —
+        the returned result honestly shows any unscheduled pods."""
+        res, _t_attempts, _t_retries = run_trial(k, n_pad)
+        g = good(res)
+        if journal is not None:
+            journal.append("final", node_count=k, good=g)
+        if not g:
+            if extenders:
+                log.warning(
+                    "capacity replay of the winning probe (%d nodes) no "
+                    "longer satisfies the plan — an extender answered "
+                    "differently between probes; returning the replayed "
+                    "result as-is", k,
+                )
+            else:
+                raise RuntimeError(
+                    "capacity replay of the winning probe no longer "
+                    f"satisfies the plan ({k} nodes): simulate() is "
+                    "nondeterministic"
+                )
+        return res
+
+    g0, base = probe(0)
+    if g0:
+        if base is None:
+            base = finalize(0, None)
         metrics.CAPACITY_NODES_ADDED.set(0)
         return CapacityPlan(0, base, attempts, retries)
 
@@ -228,58 +337,30 @@ def plan_capacity(
     # the node-axis shapes — and therefore the XLA executables — are
     # identical across probes: the whole search compiles once per bucket
     # instead of once per probe.
-    lo, hi = 0, max(min(lower_bound_nodes(base, new_node), max_new_nodes), 1)
-    hi_result = None
+    lo, hi = 0, (seed_hi or 1)
+    best_result: Optional[SimulateResult] = None
     while hi <= max_new_nodes:
         # (exponential probes rely on encode_nodes' default round_up(n, 64)
         # padding; only the bisection below needs an explicit pin, so every
         # mid-probe shares the bracket's bucket)
-        hi_result = probe(hi)
-        if good(hi_result):
+        g, hi_result = probe(hi)
+        if g:
+            best_result = hi_result
             break
         lo = hi  # a failed probe IS a verified lower bound
         hi *= 2
     else:
         return None
-    best, best_result = hi, hi_result
-    last_result = hi_result
+    best = hi
     n_pad = round_up(n_base + hi, 64)
     while lo + 1 < hi:
         mid = (lo + hi) // 2
-        res = probe(mid, n_pad=n_pad)
-        last_result = res
-        if good(res):
+        g, res = probe(mid, n_pad=n_pad)
+        if g:
             hi, best, best_result = mid, mid, res
         else:
             lo = mid
-    if last_result is not best_result:
-        # Probes share cached pod objects, and every probe rebinds them — so
-        # an earlier probe's result no longer reflects its own placements.
-        # Replay the winning count once so the returned result's pods carry
-        # their true bindings (same executables, so this is one cheap run).
-        best_result = probe(best, n_pad=n_pad)
-        # The replay's correctness rests on run-to-run determinism of
-        # simulate (e.g. DaemonSet pods re-expand with fresh RNG-suffixed
-        # names, which must never influence placement). One cheap re-check
-        # turns any future nondeterminism into a loud error instead of a
-        # silently-wrong CapacityPlan. HTTP extenders are legitimately
-        # non-reproducible (stateful endpoints, transient timeouts on
-        # ignorable extenders), so with extenders configured the mismatch is
-        # attributed and tolerated — the returned result honestly shows any
-        # unscheduled pods.
-        if not good(best_result):
-            if extenders:
-                log.warning(
-                    "capacity replay of the winning probe (%d nodes) no "
-                    "longer satisfies the plan — an extender answered "
-                    "differently between probes; returning the replayed "
-                    "result as-is", best,
-                )
-            else:
-                raise RuntimeError(
-                    "capacity replay of the winning probe no longer "
-                    f"satisfies the plan ({best} nodes): simulate() is "
-                    "nondeterministic"
-                )
+    if best_result is None or best_result is not last_live:
+        best_result = finalize(best, n_pad)
     metrics.CAPACITY_NODES_ADDED.set(best)
     return CapacityPlan(best, best_result, attempts, retries)

@@ -15,11 +15,13 @@ Plan schema:
     seed: 7
     rules:
       - target: extender          # extender | kubeclient | chart
+                                  # | backend | journal
         op: filter                # optional substring match on the call's
                                   # operation (extender verb, api path,
-                                  # chart release/path); empty = any
+                                  # chart release/path, backend stage,
+                                  # journal event); empty = any
         kind: connection_error    # latency | connection_error | http_error
-                                  # | malformed_json | error
+                                  # | malformed_json | error | kill
         times: 2                  # inject on the first 2 matching calls
                                   # (omit = every matching call)
         after: 0                  # skip this many matching calls first
@@ -46,8 +48,11 @@ import yaml
 
 from ..utils import metrics
 
-TARGETS = ("extender", "kubeclient", "chart")
-KINDS = ("latency", "connection_error", "http_error", "malformed_json", "error")
+TARGETS = ("extender", "kubeclient", "chart", "backend", "journal")
+KINDS = (
+    "latency", "connection_error", "http_error", "malformed_json", "error",
+    "kill",
+)
 
 
 class FaultInjectionError(Exception):
@@ -286,3 +291,35 @@ def apply_chart_fault(rule: FaultRule, what: str) -> None:
     from ..utils.chart import ChartError
 
     raise ChartError(f"injected by fault plan ({rule.kind}) rendering {what}")
+
+
+def apply_backend_fault(rule: FaultRule) -> None:
+    """Backend acquisition faults reproduce the observed wedge modes:
+    latency simulates the r03–r05 tunnel hang (the watchdog must fire),
+    every other kind is an immediate init failure."""
+    import time as _time
+
+    if rule.kind == "latency":
+        if rule.latency_s > 0:
+            _time.sleep(rule.latency_s)
+        return
+    if rule.kind == "kill":
+        os.kill(os.getpid(), 9)
+    raise RuntimeError(f"injected by fault plan ({rule.kind}): backend init failed")
+
+
+def apply_journal_fault(rule: FaultRule) -> None:
+    """Journal faults model a dying host. `kill` SIGKILLs the process
+    *before* the record is written — the deterministic crash the
+    crash-resume smoke uses (the k-th trial is then NOT committed, exactly
+    like a preemption between probe and commit). Other error kinds surface
+    as an OSError the journal wraps in JournalError."""
+    import time as _time
+
+    if rule.kind == "latency":
+        if rule.latency_s > 0:
+            _time.sleep(rule.latency_s)
+        return
+    if rule.kind == "kill":
+        os.kill(os.getpid(), 9)
+    raise OSError(f"injected by fault plan ({rule.kind}): journal write failed")

@@ -485,6 +485,21 @@ SANITIZER_VIOLATIONS = REGISTRY.counter(
     "jit entry point.",
     labelnames=("entry",),
 )
+WATCHDOG_FIRED = REGISTRY.counter(
+    "osim_watchdog_fired_total",
+    "Watchdog deadlines that fired on a guarded call (backend acquisition, "
+    "compile/execute), by stage.",
+    labelnames=("stage",),
+)
+RUN_RESUMED = REGISTRY.counter(
+    "osim_run_resumed_total",
+    "Runs resumed from a journal (apply/bench --resume).",
+)
+JOURNAL_EVENTS = REGISTRY.counter(
+    "osim_journal_events_total",
+    "Records durably committed to run journals, by event type.",
+    labelnames=("event",),
+)
 
 # Span names that map onto a dedicated kube-parity histogram; everything
 # else lands only in osim_span_duration_seconds{span=...}.

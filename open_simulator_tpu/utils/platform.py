@@ -42,11 +42,13 @@ def _host_fingerprint() -> str:
     return hashlib.blake2b(blob.encode(), digest_size=6).hexdigest()
 
 
-def enable_compilation_cache() -> None:
+def enable_compilation_cache() -> "str | None":
     """Persist XLA executables across processes (parity concern: the
     reference binary re-simulates a tweaked cluster interactively in seconds,
     apply.go:203-216 — repeat `simon apply` runs must not re-pay 30s+ of
-    compilation). Directory override: OSIM_COMPILE_CACHE; empty disables."""
+    compilation). Directory override: OSIM_COMPILE_CACHE; empty disables.
+    Returns the cache directory when enabled (the backend watchdog journals
+    it on its warm-cache retry), else None."""
     path = os.environ.get(
         "OSIM_COMPILE_CACHE",
         os.path.join(
@@ -54,7 +56,7 @@ def enable_compilation_cache() -> None:
         ),
     )
     if not path:
-        return
+        return None
     try:
         # Key the cache by a host-CPU fingerprint: XLA:CPU AOT executables
         # record the *compile* machine's feature set, and loading them on a
@@ -69,8 +71,9 @@ def enable_compilation_cache() -> None:
         # cache every executable, however fast the compile looked
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        return path
     except Exception:
-        pass  # cache is an optimization — never fail an entry point over it
+        return None  # cache is an optimization — never fail an entry point over it
 
 
 _compile_listener_installed = False

@@ -28,7 +28,15 @@ chain_capture_if_passed() { # chain_capture_if_passed chunk file...
         [ -n "$chunk" ] && export OSIM_HEADLINE_CHUNK="$chunk"
         note "full headline passed — chaining into the round capture" \
             "(chunk=${OSIM_HEADLINE_CHUNK:-default})"
+        # `| tee` swallows the capture's exit status: a CPU-fallback capture
+        # exits nonzero (tpu_round_capture.sh provenance guard) and must not
+        # read as success to the ladder, so take the pipeline head's status.
         bash scripts/tpu_round_capture.sh 2>&1 | tee -a "$SUMMARY"
+        local rc=${PIPESTATUS[0]}
+        if [ "$rc" -ne 0 ]; then
+            note "round capture FAILED (rc=$rc) — not banked as TPU evidence"
+            return "$rc"
+        fi
     else
         note "ladder done; full headline did not pass — bracket is in $OUT"
         return 1

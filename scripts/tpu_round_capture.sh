@@ -15,13 +15,33 @@ cd "$(dirname "$0")/.."
 echo "== 1/3 bench (TPU) =="
 # JAX_PLATFORMS=axon requests the tunnel, but bench's own backend probe
 # still falls back to CPU when the tunnel flaps (bench.py _select_backend) —
-# so verify the recorded device string and refuse to mislabel a CPU run as
-# the round's TPU capture.
-JAX_PLATFORMS=axon timeout 7200 python bench.py 2>"$OUT/bench.err" | tail -1 > "$OUT/bench_tpu.json"
+# so verify the recorded provenance and refuse to mislabel a CPU run as
+# the round's TPU capture. bench stamps device/fallback as TOP-LEVEL
+# fields; parse those, not a whole-file grep (per-segment payloads and the
+# metrics snapshot can contain device strings for the wrong backend).
+# --run-dir journals every segment, so a wedged capture resumes with
+#   scripts/tpu_round_capture.sh --resume
+RESUME_ARGS=()
+[ "${1:-}" = "--resume" ] && RESUME_ARGS=(--resume)
+JAX_PLATFORMS=axon timeout 7200 python bench.py \
+    --run-dir "$OUT/run" "${RESUME_ARGS[@]}" \
+    2>"$OUT/bench.err" | tail -1 > "$OUT/bench_tpu.json"
 tail -c 400 "$OUT/bench_tpu.json"; echo
-if ! grep -q '"device": "TPU' "$OUT/bench_tpu.json"; then
+if ! python - "$OUT/bench_tpu.json" <<'EOF'
+import json, sys
+try:
+    d = json.load(open(sys.argv[1]))
+except (OSError, ValueError):
+    sys.exit(1)
+ok = str(d.get("device", "")).startswith("TPU") and d.get("fallback") != "cpu"
+sys.exit(0 if ok else 1)
+EOF
+then
     mv "$OUT/bench_tpu.json" "$OUT/bench_cpu_fallback.json"
     echo "stage 1 fell back to CPU — saved as bench_cpu_fallback.json, NOT a TPU capture"
+    # Nonzero so callers (chain_capture_if_passed) never bank this as the
+    # round's TPU evidence; stages 2/3 are meaningless off-device anyway.
+    exit 1
 fi
 
 echo "== 2/3 Pallas parity (compiled, real TPU) =="
