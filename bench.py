@@ -655,6 +655,138 @@ def config_sanitize_overhead(n_pods=1_000, n_nodes=100):
     return out
 
 
+def config_serving_concurrent(
+    n_clients=16, n_requests=4, queue_depth=8, coalesce_ms=50.0
+):
+    """Config 9: the overload-safe serving path (docs/serving.md). M
+    concurrent clients burst identical deploy-apps requests at an embedded
+    server with a bounded admission queue and a coalescing window; reports
+    p50/p99 latency, req/s, shed rate, and the mean coalesced batch size —
+    "heavy traffic" as a number. Every response must be definite (200 or a
+    shed 429-with-Retry-After); anything else is reported as an error."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from open_simulator_tpu.server import server as server_mod
+    from open_simulator_tpu.utils import metrics
+
+    def raw_node(name):
+        res = {"cpu": "32", "memory": "64Gi", "pods": "110"}
+        return {
+            "kind": "Node",
+            "metadata": {
+                "name": name, "labels": {"kubernetes.io/hostname": name},
+            },
+            "status": {"allocatable": dict(res), "capacity": dict(res)},
+        }
+
+    body = json.dumps(
+        {
+            "cluster": {"objects": [raw_node(f"n-{i}") for i in range(20)]},
+            "apps": [
+                {
+                    "name": "web",
+                    "objects": [_mk_deploy("web", 100, "500m", "1Gi")],
+                }
+            ],
+        }
+    ).encode()
+
+    srv = server_mod.make_server(
+        0, queue_depth=queue_depth, coalesce_ms=coalesce_ms
+    )
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{port}/api/deploy-apps"
+
+    def one(timeout=120.0):
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"}
+        )
+        t0 = time.time()
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.status, time.time() - t0
+        except urllib.error.HTTPError as e:
+            e.read()
+            return e.code, time.time() - t0
+        except Exception:
+            return -1, time.time() - t0
+
+    # Warm pass: compile the simulate executables before the timed burst so
+    # the latency distribution measures serving, not first-compile.
+    warm_status, _ = one()
+    try:
+        if warm_status != 200:
+            return {"error": f"warm-up request returned {warm_status}"}
+        metrics.REGISTRY.reset()
+
+        outcomes: list = []
+        lock = threading.Lock()
+        barrier = threading.Barrier(n_clients)
+
+        def client():
+            barrier.wait()
+            mine = [one() for _ in range(n_requests)]
+            with lock:
+                outcomes.extend(mine)
+
+        threads = [threading.Thread(target=client) for _ in range(n_clients)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.time() - t0
+
+        total = len(outcomes)
+        ok_lat = sorted(lat for code, lat in outcomes if code == 200)
+        shed = sum(1 for code, _ in outcomes if code in (429, 503))
+        other = total - len(ok_lat) - shed
+        _, co_sum, co_count = metrics.COALESCED_BATCH.child_state()
+        shed_by_reason = {
+            s["labels"]["reason"]: int(s["value"])
+            for s in metrics.REQUESTS_SHED.snapshot()["samples"]
+        }
+
+        def pct(p):
+            if not ok_lat:
+                return None
+            return round(
+                1000 * ok_lat[min(len(ok_lat) - 1, int(p * len(ok_lat)))], 1
+            )
+
+        out = {
+            "wall_s": round(wall, 2),
+            "value": round(len(ok_lat) / wall, 1) if wall > 0 else 0.0,
+            "unit": "req/s",
+            "clients": n_clients,
+            "requests": total,
+            "ok": len(ok_lat),
+            "shed": shed,
+            "shed_rate": round(shed / total, 3) if total else 0.0,
+            "shed_by_reason": shed_by_reason,
+            "p50_latency_ms": pct(0.50),
+            "p99_latency_ms": pct(0.99),
+            "queue_depth": queue_depth,
+            "coalesce_ms": coalesce_ms,
+            "coalesced_batch_mean": (
+                round(co_sum / co_count, 2) if co_count else 0.0
+            ),
+        }
+        if other:
+            # 200 and shed-with-Retry-After are the only acceptable answers
+            out["error"] = (
+                f"{other} request(s) got a non-200/non-shed response: "
+                f"{sorted({c for c, _ in outcomes if c not in (200, 429, 503)})}"
+            )
+        return out
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
 CONFIGS = {
     "stock": config_stock,
     "fit_1k_100n": config_fit,
@@ -664,6 +796,7 @@ CONFIGS = {
     "plan_100k_10k": config_plan,
     "preempt_tiered": config_preempt,
     "extender_1k": config_extender,
+    "serving_concurrent": config_serving_concurrent,
 }
 
 
@@ -776,6 +909,7 @@ SEGMENT_TIMEOUT_S = {
     "plan_100k_10k": 1200.0,
     "preempt_tiered": 900.0,
     "extender_1k": 900.0,
+    "serving_concurrent": 600.0,
 }
 
 

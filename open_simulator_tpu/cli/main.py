@@ -313,12 +313,20 @@ def _run_chaos(args) -> int:
     retries = total(metrics.RETRY_ATTEMPTS)
     skips = total(metrics.EXTENDER_SKIPPED)
     stale = total(metrics.SNAPSHOT_STALE)
+    # Overload accounting (docs/serving.md): shedding is the admission
+    # queue WORKING — every shed client got a definite 429/503 with a
+    # Retry-After, so it is degradation; a drop (no response at all) is the
+    # failure mode admission control exists to prevent.
+    shed = total(metrics.REQUESTS_SHED)
+    dropped = total(metrics.REQUESTS_DROPPED)
     failed_apps = sorted(fa.name for fa in outcome.failed_apps)
     not_closed = sorted(
         ep for ep, state in breaker_states().items() if state != "closed"
     )
     unscheduled = outcome.result.unscheduled
-    degraded = bool(retries or skips or stale or failed_apps or not_closed)
+    degraded = bool(
+        retries or skips or stale or failed_apps or not_closed or shed
+    )
 
     lines.append("degraded:")
     lines.append(
@@ -328,6 +336,7 @@ def _run_chaos(args) -> int:
     lines.append(f"  retries performed: {retries}")
     lines.append(f"  ignorable extenders skipped: {skips}")
     lines.append(f"  stale snapshots served: {stale}")
+    lines.append(f"  requests shed with Retry-After: {shed}")
     lines.append(
         "  circuit breakers not closed: "
         + (", ".join(not_closed) if not_closed else "none")
@@ -336,9 +345,14 @@ def _run_chaos(args) -> int:
     lines.append(f"  unscheduled pods: {len(unscheduled)}")
     for reason in sorted({u.reason for u in unscheduled}):
         lines.append(f"    reason: {reason}")
+    lines.append(f"  requests dropped without response: {dropped}")
     if unscheduled:
         lines.append(
             "outcome: failed — pods went unscheduled under the fault plan"
+        )
+    elif dropped:
+        lines.append(
+            "outcome: failed — requests were dropped without a response"
         )
     elif degraded:
         lines.append("outcome: degraded — simulation completed under faults")
@@ -473,6 +487,21 @@ def main(argv=None) -> int:
         help="apiserver URL overriding the kubeconfig's server "
         "(cmd/server/options.go parity)",
     )
+    ps.add_argument(
+        "--queue-depth", type=int, default=None,
+        help="admission queue depth before 429 shedding "
+        "(default: OSIM_SERVER_QUEUE_DEPTH or 16; docs/serving.md)",
+    )
+    ps.add_argument(
+        "--coalesce-ms", type=float, default=None,
+        help="micro-batching window for identical concurrent requests "
+        "(default: OSIM_SERVER_COALESCE_MS or 0 = off)",
+    )
+    ps.add_argument(
+        "--default-deadline-ms", type=float, default=None,
+        help="deadline applied to requests without an X-Osim-Deadline-Ms "
+        "header (default: OSIM_SERVER_DEFAULT_DEADLINE_MS or 0 = none)",
+    )
     sub.add_parser(
         "version", help="print version", description="print version"
     )
@@ -520,7 +549,14 @@ def main(argv=None) -> int:
     if args.command == "server":
         from ..server.server import serve
 
-        return serve(port=args.port, kubeconfig=args.kubeconfig, master=args.master)
+        return serve(
+            port=args.port,
+            kubeconfig=args.kubeconfig,
+            master=args.master,
+            queue_depth=args.queue_depth,
+            coalesce_ms=args.coalesce_ms,
+            default_deadline_ms=args.default_deadline_ms,
+        )
     if args.command == "apply":
         from ..api.config import SimonConfig
         from ..engine.apply import ApplyError, run_apply

@@ -15,13 +15,16 @@ Plan schema:
     seed: 7
     rules:
       - target: extender          # extender | kubeclient | chart
-                                  # | backend | journal
+                                  # | backend | journal | admission
         op: filter                # optional substring match on the call's
                                   # operation (extender verb, api path,
                                   # chart release/path, backend stage,
-                                  # journal event); empty = any
+                                  # journal event, admission phase
+                                  # "submit"/"drain"); empty = any
         kind: connection_error    # latency | connection_error | http_error
                                   # | malformed_json | error | kill
+                                  # | queue_full | slow_drain
+                                  # | deadline_storm  (admission only)
         times: 2                  # inject on the first 2 matching calls
                                   # (omit = every matching call)
         after: 0                  # skip this many matching calls first
@@ -48,10 +51,10 @@ import yaml
 
 from ..utils import metrics
 
-TARGETS = ("extender", "kubeclient", "chart", "backend", "journal")
+TARGETS = ("extender", "kubeclient", "chart", "backend", "journal", "admission")
 KINDS = (
     "latency", "connection_error", "http_error", "malformed_json", "error",
-    "kill",
+    "kill", "queue_full", "slow_drain", "deadline_storm",
 )
 
 

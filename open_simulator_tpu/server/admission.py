@@ -1,0 +1,458 @@
+"""Overload-safe serving core: admission, deadlines, coalescing, shedding.
+
+The REST path is the one surface that faces arbitrary client concurrency,
+and until this module existed it was single-flight: `server.py` guarded
+POST with a non-blocking try-lock, so under N concurrent clients N−1 got
+an instant 503 with no queueing, no Retry-After, and no shed accounting.
+Production schedulers treat admission as a first-class scheduling
+decision; this module is that front door:
+
+* **Bounded admission queue** — POST bodies are enqueued up to
+  `OSIM_SERVER_QUEUE_DEPTH` and drained by one dedicated scheduler-worker
+  thread (simulate calls stay serialized exactly as under the old lock,
+  so the engine sees no new concurrency). When the queue is full the
+  request is *shed*: 429 plus a `Retry-After` computed from the observed
+  service-time EWMA and the current backlog — an honest "come back in
+  N seconds", not a blind 503.
+
+* **Deadline propagation** — an `X-Osim-Deadline-Ms` request header (or
+  the `OSIM_SERVER_DEFAULT_DEADLINE_MS` default) rides through the queue
+  as an absolute deadline. A request whose deadline passes while it is
+  still queued is shed *at dequeue* — cheap, before any simulate work —
+  and the remaining budget of requests that do start is handed to the
+  simulate call's watchdog (`durable/watchdog.guarded_call`), so a
+  deadline can abort a wedged simulate mid-flight (504) instead of
+  letting the client hang.
+
+* **Coalescing window** — requests arriving within
+  `OSIM_SERVER_COALESCE_MS` of the batch head are drained together;
+  requests with the same coalesce key (body digest + snapshot
+  generation) run as ONE entry in the batch executor and the result is
+  fanned back out to every waiter. The batch executor
+  (`execute(bodies) -> results`) is the seam the vmapped multi-scenario
+  engine (ROADMAP item 1) will slot into; today it loops.
+
+* **Shed accounting** — `osim_requests_shed_total{reason=queue_full|
+  deadline|draining}`, `osim_admission_queue_depth`,
+  `osim_coalesced_batch_size`, and a request-latency histogram make
+  overload visible; `osim_requests_dropped_total` counts the one failure
+  mode that is never acceptable (a waiter abandoned without a response —
+  only possible if the worker dies) so `simon chaos` can classify
+  shed-with-Retry-After as *degraded* and dropped as *failed*.
+
+Every response is definite: 200 (simulated), 400 (bad request /
+simulation error), 429 + Retry-After (shed: queue full or deadline),
+503 + Retry-After (shed: draining on SIGTERM), 504 (deadline fired
+mid-simulate), or 500 (dropped — worker death, counted and reported).
+
+Tests drive the queue without the worker thread (`run_pending()`) under
+an injectable clock, so queue-full/deadline/coalescing behavior is
+provable sleep-free — the same idiom as `resilience/policy.py` and
+`durable/watchdog.py`.
+
+Fault injection (docs/resilience.md): target `admission`, kinds
+`queue_full` / `deadline_storm` (consulted at submit, op "submit") and
+`slow_drain` (consulted per drained batch, op "drain").
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ..durable.watchdog import DeadlineExceeded, call_deadline_s, guarded_call
+from ..resilience import faults
+from ..utils import metrics
+from ..utils.tracing import log
+
+# Serve-time defaults; the env knobs are resolved when the queue is
+# constructed (serve()/make_server() time), never at import.
+DEFAULT_QUEUE_DEPTH = 16
+DEFAULT_COALESCE_MS = 0.0
+DEFAULT_DEADLINE_MS = 0.0
+DEFAULT_SERVICE_TIME_S = 1.0
+
+REASON_QUEUE_FULL = "queue_full"
+REASON_DEADLINE = "deadline"
+REASON_DRAINING = "draining"
+
+#: Shed reason -> HTTP status. queue_full/deadline are client-retryable
+#: (429); draining means THIS server is going away (503 + Retry-After).
+_SHED_CODE = {
+    REASON_QUEUE_FULL: 429,
+    REASON_DEADLINE: 429,
+    REASON_DRAINING: 503,
+}
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        log.warning("%s=%r is not a number; using %g", name, raw, default)
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(_env_float(name, float(default)))
+
+
+def coalesce_key(path: str, body: dict, generation: Optional[int] = None) -> str:
+    """Stable identity of a request's *work*: two requests with the same key
+    would produce byte-identical results, so one simulate pass serves both.
+    `generation` folds in the live-snapshot generation for kubeconfig-backed
+    requests (the same body against a refreshed snapshot is different work)."""
+    digest = hashlib.sha256(
+        json.dumps(body, sort_keys=True, separators=(",", ":")).encode()
+    ).hexdigest()
+    if generation is None:
+        return f"{path}:{digest}"
+    return f"{path}:{digest}:gen{generation}"
+
+
+@dataclass
+class Ticket:
+    """One admitted (or shed) request. The handler thread blocks on `done`;
+    the scheduler worker (or submit itself, for sheds) finalizes it."""
+
+    body: dict
+    key: str
+    enqueued_at: float
+    deadline_at: Optional[float] = None  # absolute, clock() domain
+    done: threading.Event = field(default_factory=threading.Event)
+    # response (valid once done is set)
+    code: int = 0
+    payload: Optional[dict] = None
+    headers: Dict[str, str] = field(default_factory=dict)
+    shed_reason: str = ""
+
+    def remaining_s(self, now: float) -> Optional[float]:
+        if self.deadline_at is None:
+            return None
+        return self.deadline_at - now
+
+
+class AdmissionQueue:
+    """Bounded admission queue drained by one scheduler worker thread.
+
+    `execute` is the batch executor: it receives the drained batch's
+    UNIQUE bodies (one per coalesce key, in arrival order) and returns one
+    result per body — a payload dict, or an Exception instance for a
+    per-body failure. All other parameters default from the environment at
+    construction time (never import time):
+
+        OSIM_SERVER_QUEUE_DEPTH         max queued requests (beyond the
+                                        batch being executed)
+        OSIM_SERVER_COALESCE_MS         micro-batching window; 0 disables
+        OSIM_SERVER_DEFAULT_DEADLINE_MS deadline for requests that carry
+                                        no X-Osim-Deadline-Ms; 0 = none
+
+    `clock` and `watchdog_poll_s` are injectable so tests prove deadline
+    and shed behavior without sleeping.
+    """
+
+    def __init__(
+        self,
+        execute: Callable[[List[dict]], List[Any]],
+        *,
+        depth: Optional[int] = None,
+        coalesce_ms: Optional[float] = None,
+        default_deadline_ms: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+        service_time_s: float = DEFAULT_SERVICE_TIME_S,
+        watchdog_poll_s: float = 0.25,
+    ) -> None:
+        self._execute = execute
+        self.depth = (
+            depth
+            if depth is not None
+            else _env_int("OSIM_SERVER_QUEUE_DEPTH", DEFAULT_QUEUE_DEPTH)
+        )
+        if self.depth < 1:
+            raise ValueError(f"queue depth must be >= 1, got {self.depth}")
+        self.coalesce_s = (
+            coalesce_ms
+            if coalesce_ms is not None
+            else _env_float("OSIM_SERVER_COALESCE_MS", DEFAULT_COALESCE_MS)
+        ) / 1000.0
+        self.default_deadline_ms = (
+            default_deadline_ms
+            if default_deadline_ms is not None
+            else _env_float("OSIM_SERVER_DEFAULT_DEADLINE_MS", DEFAULT_DEADLINE_MS)
+        )
+        self._clock = clock
+        self._poll_s = watchdog_poll_s
+        self._cv = threading.Condition()
+        self._queue: List[Ticket] = []
+        self._draining = False
+        self._service_time_s = max(float(service_time_s), 0.001)
+        self._worker: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "AdmissionQueue":
+        self._worker = threading.Thread(
+            target=self._worker_loop, name="osim-admission-worker", daemon=True
+        )
+        self._worker.start()
+        return self
+
+    def shutdown(self) -> None:
+        """Begin draining: shed everything still QUEUED (reason=draining,
+        those clients should retry elsewhere) and let the batch already
+        executing complete and respond. Idempotent."""
+        with self._cv:
+            self._draining = True
+            for t in self._queue:
+                self._shed_locked(t, REASON_DRAINING)
+            self._queue.clear()
+            metrics.ADMISSION_QUEUE_DEPTH.set(0)
+            self._cv.notify_all()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._worker is not None:
+            self._worker.join(timeout)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    # -- submit / wait (handler-thread side) --------------------------------
+
+    def submit(
+        self,
+        body: dict,
+        *,
+        key: Optional[str] = None,
+        deadline_ms: Optional[float] = None,
+        op: str = "submit",
+    ) -> Ticket:
+        """Admit, or immediately shed, one request. Never blocks."""
+        now = self._clock()
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        ticket = Ticket(
+            body=body,
+            key=key if key is not None else coalesce_key("", body),
+            enqueued_at=now,
+            deadline_at=(now + deadline_ms / 1000.0) if deadline_ms > 0 else None,
+        )
+        rule = faults.maybe_inject("admission", op)
+        with self._cv:
+            if self._draining:
+                self._shed_locked(ticket, REASON_DRAINING)
+                return ticket
+            if rule is not None and rule.kind == "queue_full":
+                self._shed_locked(ticket, REASON_QUEUE_FULL)
+                return ticket
+            if rule is not None and rule.kind == "deadline_storm":
+                # every request arrives with its deadline already spent —
+                # must be shed at dequeue without entering a simulate call
+                ticket.deadline_at = now
+            if len(self._queue) >= self.depth:
+                self._shed_locked(ticket, REASON_QUEUE_FULL)
+                return ticket
+            self._queue.append(ticket)
+            metrics.ADMISSION_QUEUE_DEPTH.set(len(self._queue))
+            self._cv.notify_all()
+        return ticket
+
+    def wait(self, ticket: Ticket, poll_s: float = 1.0) -> Ticket:
+        """Block the handler thread until the ticket is finalized. If the
+        worker dies with the ticket unfinalized (the only way a request
+        could be silently dropped), answer 500 and count it dropped."""
+        while not ticket.done.wait(poll_s):
+            worker = self._worker
+            if worker is not None and not worker.is_alive():
+                self._drop(ticket)
+                break
+        return ticket
+
+    # -- the scheduler worker -----------------------------------------------
+
+    def _worker_loop(self) -> None:
+        try:
+            while True:
+                batch = self._collect_batch()
+                if batch is None:
+                    return
+                self._run_batch(batch)
+        except BaseException:  # pragma: no cover - worker must never die silently
+            log.exception("admission worker crashed; draining queue as dropped")
+            with self._cv:
+                for t in self._queue:
+                    self._drop(t)
+                self._queue.clear()
+                metrics.ADMISSION_QUEUE_DEPTH.set(0)
+            raise
+
+    def _collect_batch(self) -> Optional[List[Ticket]]:
+        """Wait for work, hold the coalescing window open, then take the
+        whole backlog as one batch. Returns None when drained out."""
+        with self._cv:
+            while not self._queue and not self._draining:
+                self._cv.wait()
+            if not self._queue:  # draining and empty
+                return None
+            if self.coalesce_s > 0:
+                head = self._queue[0]
+                window_end = head.enqueued_at + self.coalesce_s
+                while not self._draining:
+                    remaining = window_end - self._clock()
+                    if remaining <= 0 or len(self._queue) >= self.depth:
+                        break
+                    self._cv.wait(remaining)
+            batch = list(self._queue)
+            self._queue.clear()
+            metrics.ADMISSION_QUEUE_DEPTH.set(0)
+            return batch or None
+
+    def run_pending(self) -> int:
+        """Test/embedding hook: synchronously process everything queued NOW
+        (no window waiting, no worker thread). Returns batches processed."""
+        n = 0
+        while True:
+            with self._cv:
+                batch = list(self._queue)
+                self._queue.clear()
+                metrics.ADMISSION_QUEUE_DEPTH.set(0)
+            if not batch:
+                return n
+            self._run_batch(batch)
+            n += 1
+
+    def _run_batch(self, batch: List[Ticket]) -> None:
+        now = self._clock()
+        # 1. deadline sheds AT DEQUEUE: expired requests never reach execute
+        live: List[Ticket] = []
+        for t in batch:
+            if t.deadline_at is not None and now >= t.deadline_at:
+                self._shed(t, REASON_DEADLINE)
+            else:
+                live.append(t)
+        if not live:
+            return
+        # 2. injected slow drain (models a wedged backend eating the window)
+        rule = faults.maybe_inject("admission", "drain")
+        if rule is not None and rule.kind == "slow_drain" and rule.latency_s > 0:
+            time.sleep(rule.latency_s)
+        # 3. coalesce: one executor entry per distinct key, arrival order
+        groups: Dict[str, List[Ticket]] = {}
+        order: List[str] = []
+        for t in live:
+            if t.key not in groups:
+                groups[t.key] = []
+                order.append(t.key)
+            groups[t.key].append(t)
+        bodies = [groups[k][0].body for k in order]
+        # 4. watchdog budget: the most generous live deadline (a stricter
+        #    per-request budget would abort shared work other waiters still
+        #    have time for); deadline-less waiters fall back to the global
+        #    OSIM_CALL_DEADLINE_S (0 = unguarded).
+        budgets = [t.remaining_s(now) for t in live]
+        budget = call_deadline_s() if any(b is None for b in budgets) else max(budgets)
+        t0 = self._clock()
+        try:
+            results = guarded_call(
+                "serve-simulate",
+                lambda: self._execute(bodies),
+                budget if budget and budget > 0 else 0.0,
+                clock=self._clock,
+                poll_s=self._poll_s,
+            )
+            if len(results) != len(bodies):
+                raise RuntimeError(
+                    f"batch executor returned {len(results)} results "
+                    f"for {len(bodies)} bodies"
+                )
+        except DeadlineExceeded as e:
+            for t in live:
+                self._finalize(t, 504, {"error": str(e)})
+            return
+        except Exception as e:  # executor-level failure: every waiter gets a 400
+            for t in live:
+                self._finalize(t, 400, {"error": str(e)})
+            return
+        elapsed = max(self._clock() - t0, 0.0)
+        # EWMA of per-entry service time feeds Retry-After on future sheds
+        per_entry = elapsed / len(bodies)
+        with self._cv:
+            self._service_time_s = max(
+                0.3 * per_entry + 0.7 * self._service_time_s, 0.001
+            )
+        # 5. fan each group's one result back out to all of its waiters
+        for k, res in zip(order, results):
+            waiters = groups[k]
+            metrics.COALESCED_BATCH.observe(len(waiters))
+            for t in waiters:
+                if isinstance(res, BaseException):
+                    self._finalize(t, 400, {"error": str(res)})
+                else:
+                    self._finalize(t, 200, res)
+
+    # -- finalization -------------------------------------------------------
+
+    def retry_after_s(self) -> int:
+        """Honest backoff hint: the backlog's expected drain time under the
+        observed per-request service time, floored at 1 s."""
+        with self._cv:
+            backlog = len(self._queue) + 1
+            est = self._service_time_s * backlog
+        return max(1, int(math.ceil(est)))
+
+    def _shed_locked(self, ticket: Ticket, reason: str) -> None:
+        backlog = len(self._queue) + 1
+        est = self._service_time_s * backlog
+        self._finalize(
+            ticket,
+            _SHED_CODE[reason],
+            {
+                "error": f"request shed: {reason.replace('_', ' ')}",
+                "reason": reason,
+            },
+            headers={"Retry-After": str(max(1, int(math.ceil(est))))},
+            shed_reason=reason,
+        )
+
+    def _shed(self, ticket: Ticket, reason: str) -> None:
+        with self._cv:
+            self._shed_locked(ticket, reason)
+
+    def _drop(self, ticket: Ticket) -> None:
+        if ticket.done.is_set():
+            return
+        metrics.REQUESTS_DROPPED.inc()
+        self._finalize(
+            ticket, 500, {"error": "request dropped: scheduler worker died"}
+        )
+
+    def _finalize(
+        self,
+        ticket: Ticket,
+        code: int,
+        payload: dict,
+        headers: Optional[Dict[str, str]] = None,
+        shed_reason: str = "",
+    ) -> None:
+        if ticket.done.is_set():
+            return
+        ticket.code = code
+        ticket.payload = payload
+        if headers:
+            ticket.headers.update(headers)
+        ticket.shed_reason = shed_reason
+        if shed_reason:
+            metrics.REQUESTS_SHED.inc(reason=shed_reason)
+        metrics.REQUEST_LATENCY.observe(
+            max(self._clock() - ticket.enqueued_at, 0.0)
+        )
+        ticket.done.set()

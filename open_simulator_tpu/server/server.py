@@ -4,7 +4,14 @@ Parity: `/root/reference/pkg/server/server.go` — gin routes
   POST /api/deploy-apps   simulate deploying workloads onto a cluster snapshot
   POST /api/scale-apps    remove a workload's pods, re-simulate at new counts
   GET  /healthz           liveness
-with the reference's TryLock busy-rejection (503 while a simulation runs).
+
+The reference guards POST with a TryLock busy-rejection (503 while a
+simulation runs); this port upgrades that front door to real admission
+control (`server/admission.py`): a bounded queue drained by one scheduler
+worker (simulate stays serialized), honest 429 + Retry-After shedding when
+the queue is full, `X-Osim-Deadline-Ms` deadline propagation, and a
+coalescing window that batches identical concurrent requests into one
+simulate pass. Knobs and semantics: docs/serving.md.
 
 The reference snapshots a live cluster through informers; here the snapshot
 comes from the request body, a manifest directory on disk, or — when the
@@ -39,8 +46,9 @@ from ..engine.simulator import AppResource, ClusterResource, simulate
 from ..utils import metrics
 from ..utils.concurrency import guarded_by
 from ..utils.yamlio import objects_from_directory
+from . import admission as admission_mod
+from .admission import AdmissionQueue
 
-_busy = threading.Lock()
 _kubeconfig: Optional[str] = None  # set by serve()/make_server()
 _master: str = ""                  # apiserver URL override (--master)
 
@@ -49,21 +57,65 @@ _master: str = ""                  # apiserver URL override (--master)
 # startup — server.go:98-136 — rather than re-listing the apiserver per
 # request). The snapshot is re-fetched only when older than _resync_s;
 # requests in between reuse it, so per-request latency against a large real
-# cluster is simulation-bound, not list-bound. Accessed only under _busy.
+# cluster is simulation-bound, not list-bound. Handler threads read the
+# generation while the scheduler worker refreshes, so all access is under
+# _snapshot_lock (the old design piggybacked on the POST _busy try-lock,
+# which admission control removed).
 RESYNC_SECONDS = 30.0
 _resync_s = RESYNC_SECONDS
+_snapshot_lock = threading.Lock()
 _snapshot: Optional[ClusterResource] = None
 _snapshot_at = 0.0
-_snapshot_fetches = 0  # observability + test hook
+_snapshot_fetches = 0  # observability + test hook; doubles as the generation
 
 # Per-connection socket read timeout: a slow-loris client trickling a request
-# body would otherwise pin a handler thread — and, on POST, the _busy lock's
-# 503 semantics — forever. Body reads that exceed it return 408.
-REQUEST_TIMEOUT_S = float(os.environ.get("OSIM_SERVER_REQUEST_TIMEOUT_S", "30"))
+# body would otherwise pin a handler thread forever. Body reads that exceed
+# it return 408. The OSIM_SERVER_REQUEST_TIMEOUT_S env knob is applied by
+# _resolve_env_config() at serve()/make_server() time — NOT at import, so
+# setting it after this module is imported still takes effect.
+REQUEST_TIMEOUT_S = 30.0
 
 # serve()'s active server, so the SIGTERM/SIGINT handler (and tests) can
 # trigger a graceful drain from outside the serve_forever loop.
 _current_server: Optional[ThreadingHTTPServer] = None
+
+
+def _resolve_env_config() -> None:
+    """Apply env knobs at serve()/make_server() time (the import-time read
+    these replaced silently ignored variables set after import). Only
+    overrides when the variable is actually present, so tests that poke the
+    module attributes directly keep their values."""
+    global REQUEST_TIMEOUT_S, _resync_s
+    for env, attr in (
+        ("OSIM_SERVER_REQUEST_TIMEOUT_S", "REQUEST_TIMEOUT_S"),
+        ("OSIM_SERVER_RESYNC_S", "_resync_s"),
+    ):
+        raw = os.environ.get(env, "").strip()
+        if not raw:
+            continue
+        try:
+            globals()[attr] = float(raw)
+        except ValueError:
+            from ..utils.tracing import log
+
+            log.warning("%s=%r is not a number; keeping %g", env, raw,
+                        globals()[attr])
+
+
+def _execute_bodies(bodies: list) -> list:
+    """Admission-queue batch executor: one simulate pass per unique body,
+    per-body failures returned as the Exception (the queue fans it out as a
+    400 to that key's waiters only). Resolves _simulate_request through
+    module globals at call time so tests can monkeypatch it. This loop is
+    the seam the vmapped multi-scenario engine (ROADMAP item 1) replaces
+    with one batched device call."""
+    results: list = []
+    for body in bodies:
+        try:
+            results.append(_simulate_request(body))
+        except Exception as e:
+            results.append(e)
+    return results
 
 
 class _DrainingHTTPServer(ThreadingHTTPServer):
@@ -75,18 +127,74 @@ class _DrainingHTTPServer(ThreadingHTTPServer):
     Non-daemon handlers make the close a real drain: every request already
     being computed completes and its response is sent before the process
     exits. The per-socket REQUEST_TIMEOUT_S bounds how long a wedged or idle
-    keep-alive client can stall that drain."""
+    keep-alive client can stall that drain.
+
+    Owns the AdmissionQueue: close first sheds everything still queued
+    (reason=draining, 503 + Retry-After), then joins handler threads — the
+    in-flight batch finishes and its waiters get real responses."""
 
     daemon_threads = False
+    # socketserver's default TCP accept backlog is 5: a concurrent burst
+    # larger than that gets kernel-level connection resets BEFORE admission
+    # control can answer with an honest 429. The queue's shed path is the
+    # only overload response allowed to reject a client, so the backlog
+    # must comfortably exceed any burst the admission queue is sized for.
+    request_queue_size = 128
+
+    def __init__(
+        self,
+        addr,
+        handler,
+        *,
+        queue_depth: Optional[int] = None,
+        coalesce_ms: Optional[float] = None,
+        default_deadline_ms: Optional[float] = None,
+    ) -> None:
+        super().__init__(addr, handler)
+        self.admission = AdmissionQueue(
+            _execute_bodies,
+            depth=queue_depth,
+            coalesce_ms=coalesce_ms,
+            default_deadline_ms=default_deadline_ms,
+        ).start()
+
+    def server_close(self) -> None:
+        self.admission.shutdown()   # queued work -> 503 draining + Retry-After
+        super().server_close()      # joins in-flight handler threads
+        self.admission.join(timeout=5.0)
 
 
-@guarded_by("_busy")
+def _snapshot_generation() -> int:
+    """Identity of the cached live snapshot, folded into coalesce keys so
+    identical bodies against different snapshots are never merged."""
+    with _snapshot_lock:
+        return _snapshot_fetches
+
+
+def _coalesce_key_for(path: str, body: dict) -> str:
+    spec = body.get("cluster") or {}
+    uses_live = (
+        "path" not in spec
+        and not spec.get("objects")
+        and bool(_kubeconfig or _master)
+    )
+    return admission_mod.coalesce_key(
+        path, body, generation=_snapshot_generation() if uses_live else None
+    )
+
+
 def _live_snapshot() -> ClusterResource:
     """Cached kubeconfig/master-backed cluster snapshot. Returns a fresh
     ClusterResource wrapper over shared immutable objects: request handling
     appends newNodes / filters pods on the wrapper's lists, and simulate()
     deep-copies every pod it mutates, so sharing Node/Pod objects across
     requests is safe."""
+    with _snapshot_lock:
+        return _refresh_snapshot_locked()
+
+
+@guarded_by("_snapshot_lock")
+def _refresh_snapshot_locked() -> ClusterResource:
     import time
 
     global _snapshot, _snapshot_at, _snapshot_fetches
@@ -266,7 +374,7 @@ def _goroutine_dump() -> dict:
 
 
 _tracemalloc_on = False
-# /debug/pprof/heap is served off _Handler threads with no _busy gating, so
+# /debug/pprof/heap is served off concurrent _Handler threads, so
 # two concurrent requests can both observe _tracemalloc_on False, both call
 # tracemalloc.start() and both mislabel their snapshot "tracing just
 # started" — serialize the check-then-act.
@@ -322,12 +430,16 @@ class _Handler(BaseHTTPRequestHandler):
             path=urlparse(self.path).path, code=str(code)
         )
 
-    def _send(self, code: int, payload: dict) -> None:
+    def _send(
+        self, code: int, payload: dict, headers: Optional[dict] = None
+    ) -> None:
         data = json.dumps(payload).encode()
         self._count(code)
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        for name, value in (headers or {}).items():
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(data)
 
@@ -401,30 +513,45 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path not in ("/api/deploy-apps", "/api/scale-apps"):
             self._send(404, {"error": "not found"})
             return
-        if not _busy.acquire(blocking=False):
-            self._send(503, {"error": "simulation in progress, try again later"})
-            return
-        # Release BEFORE sending: once the client has the response it may fire
-        # the next request immediately, and a send-then-release order loses
-        # that race and bounces it with a spurious 503.
+        # Body I/O stays on the handler thread: the scheduler worker must
+        # never block on a client socket, so a slow-loris client costs one
+        # handler thread for at most REQUEST_TIMEOUT_S and never a queue
+        # slot or the simulate pipeline.
         try:
             length = int(self.headers.get("Content-Length", 0))
             try:
                 raw = self.rfile.read(length)
             except TimeoutError:
-                # slow-loris: the client sent headers but trickles (or never
-                # sends) the body; the socket timeout frees this thread — and
-                # the _busy lock — bounded by REQUEST_TIMEOUT_S
                 self.close_connection = True
-                code, payload = 408, {"error": "request body read timed out"}
-            else:
-                body = json.loads(raw or b"{}")
-                code, payload = 200, _simulate_request(body)
-        except Exception as e:  # surface simulation errors as 400s
-            code, payload = 400, {"error": str(e)}
-        finally:
-            _busy.release()
-        self._send(code, payload)
+                self._send(408, {"error": "request body read timed out"})
+                return
+            body = json.loads(raw or b"{}")
+        except Exception as e:
+            self._send(400, {"error": str(e)})
+            return
+        deadline_ms: Optional[float] = None
+        hdr = self.headers.get("X-Osim-Deadline-Ms")
+        if hdr is not None:
+            try:
+                deadline_ms = float(hdr)
+            except ValueError:
+                self._send(
+                    400, {"error": f"invalid X-Osim-Deadline-Ms: {hdr!r}"}
+                )
+                return
+        # Admission control (server/admission.py): enqueue or shed, then
+        # block this handler thread until the scheduler worker finalizes the
+        # ticket. Every outcome is a definite response — 200, 400, 408,
+        # 429/503 + Retry-After (shed), 504 (deadline mid-simulate), or 500
+        # (worker death, counted in osim_requests_dropped_total).
+        queue = self.server.admission
+        ticket = queue.submit(
+            body,
+            key=_coalesce_key_for(self.path, body),
+            deadline_ms=deadline_ms,
+        )
+        queue.wait(ticket)
+        self._send(ticket.code, ticket.payload or {}, headers=ticket.headers)
 
     def log_message(self, fmt, *args):  # quiet gin-style access logs
         pass
@@ -451,14 +578,24 @@ def serve(
     ready: Optional[threading.Event] = None,
     kubeconfig: str = "",
     master: str = "",
+    queue_depth: Optional[int] = None,
+    coalesce_ms: Optional[float] = None,
+    default_deadline_ms: Optional[float] = None,
 ) -> int:
     global _kubeconfig, _master, _snapshot, _snapshot_at, _current_server
+    _resolve_env_config()
     _kubeconfig = kubeconfig or None
     _master = master
     # a previous serve() in this process may have cached a snapshot of a
     # DIFFERENT cluster — never serve it against the new config
     _snapshot, _snapshot_at = None, 0.0
-    httpd = _DrainingHTTPServer(("127.0.0.1", port), _Handler)
+    httpd = _DrainingHTTPServer(
+        ("127.0.0.1", port),
+        _Handler,
+        queue_depth=queue_depth,
+        coalesce_ms=coalesce_ms,
+        default_deadline_ms=default_deadline_ms,
+    )
     _current_server = httpd
     # Graceful termination: SIGTERM (kubelet/systemd stop) and SIGINT drain
     # in-flight requests before exiting. signal.signal only works on the
@@ -487,6 +624,20 @@ def serve(
     return 0
 
 
-def make_server(port: int = 0):
-    """Embeddable server for tests; returns the ThreadingHTTPServer."""
-    return _DrainingHTTPServer(("127.0.0.1", port), _Handler)
+def make_server(
+    port: int = 0,
+    *,
+    queue_depth: Optional[int] = None,
+    coalesce_ms: Optional[float] = None,
+    default_deadline_ms: Optional[float] = None,
+):
+    """Embeddable server for tests; returns the ThreadingHTTPServer (its
+    `.admission` attribute is the live AdmissionQueue)."""
+    _resolve_env_config()
+    return _DrainingHTTPServer(
+        ("127.0.0.1", port),
+        _Handler,
+        queue_depth=queue_depth,
+        coalesce_ms=coalesce_ms,
+        default_deadline_ms=default_deadline_ms,
+    )

@@ -500,6 +500,30 @@ JOURNAL_EVENTS = REGISTRY.counter(
     "Records durably committed to run journals, by event type.",
     labelnames=("event",),
 )
+ADMISSION_QUEUE_DEPTH = REGISTRY.gauge(
+    "osim_admission_queue_depth",
+    "Requests currently waiting in the server admission queue.",
+)
+REQUESTS_SHED = REGISTRY.counter(
+    "osim_requests_shed_total",
+    "Requests shed by admission control with a definite response "
+    "(429/503 + Retry-After), by reason.",
+    labelnames=("reason",),
+)
+REQUESTS_DROPPED = REGISTRY.counter(
+    "osim_requests_dropped_total",
+    "Requests dropped without a simulated or shed response (scheduler "
+    "worker death) — any nonzero value is a failure, not degradation.",
+)
+COALESCED_BATCH = REGISTRY.histogram(
+    "osim_coalesced_batch_size",
+    "Requests answered by one coalesced simulate pass (per coalesce key).",
+    buckets=(1, 2, 4, 8, 16, 32, 64),
+)
+REQUEST_LATENCY = REGISTRY.histogram(
+    "osim_server_request_duration_seconds",
+    "Admission-to-response latency of POST simulation requests, seconds.",
+)
 
 # Span names that map onto a dedicated kube-parity histogram; everything
 # else lands only in osim_span_duration_seconds{span=...}.

@@ -1,0 +1,243 @@
+#!/usr/bin/env python3
+"""CI load smoke for the overload-safe serving path (docs/serving.md).
+
+Bursts 32 concurrent requests at an embedded server with admission queue
+depth 4 and asserts the ISSUE-7 overload contract end to end:
+
+  1. every request gets a DEFINITE answer — 200 or 429-with-Retry-After,
+     never a 5xx, a hang, or a silent drop;
+  2. the shed metrics match the arithmetic exactly:
+     osim_requests_shed_total == number of non-200 responses, and
+     osim_requests_dropped_total == 0;
+  3. a request whose deadline has already expired is shed at dequeue and
+     NEVER enters a simulate call (proved with a recording wrapper around
+     _simulate_request).
+
+Runs on CPU in-process; exits nonzero with a labeled failure otherwise.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from open_simulator_tpu.server import server as server_mod  # noqa: E402
+from open_simulator_tpu.utils import metrics  # noqa: E402
+
+BURST = 32
+DEPTH = 4
+
+
+def _body(tag):
+    res = {"cpu": "32", "memory": "64Gi", "pods": "110"}
+    return {
+        "tag": tag,
+        "cluster": {
+            "objects": [
+                {
+                    "kind": "Node",
+                    "metadata": {
+                        "name": f"n-{i}",
+                        "labels": {"kubernetes.io/hostname": f"n-{i}"},
+                    },
+                    "status": {
+                        "allocatable": dict(res), "capacity": dict(res),
+                    },
+                }
+                for i in range(10)
+            ]
+        },
+        "apps": [
+            {
+                "name": "web",
+                "objects": [
+                    {
+                        "kind": "Deployment",
+                        "metadata": {"name": "web", "namespace": "smoke"},
+                        "spec": {
+                            "replicas": 20,
+                            "template": {
+                                "metadata": {"labels": {"app": "web"}},
+                                "spec": {
+                                    "containers": [
+                                        {
+                                            "name": "c",
+                                            "image": "img",
+                                            "resources": {
+                                                "requests": {
+                                                    "cpu": "500m",
+                                                    "memory": "1Gi",
+                                                }
+                                            },
+                                        }
+                                    ]
+                                },
+                            },
+                        },
+                    }
+                ],
+            }
+        ],
+    }
+
+
+def _post(port, body, headers=None, timeout=60.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api/deploy-apps",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read() or b"{}")
+
+
+def fail(msg):
+    print(f"load smoke FAILED: {msg}")
+    sys.exit(1)
+
+
+def main():
+    srv = server_mod.make_server(0, queue_depth=DEPTH, coalesce_ms=0.0)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    # Recording + throttling wrapper: `seen` proves which request bodies
+    # actually entered simulate; the delay keeps the worker busy long
+    # enough that a 32-burst genuinely overflows a depth-4 queue.
+    real_simulate = server_mod._simulate_request
+    seen = []
+    blocker_started = threading.Event()
+
+    def recording(body):
+        seen.append(body.get("tag"))
+        if body.get("tag") == "blocker":
+            blocker_started.set()
+            time.sleep(0.2)
+        else:
+            time.sleep(0.05)
+        return real_simulate(body)
+
+    server_mod._simulate_request = recording
+
+    # Warm-up outside the measured burst (first simulate pays compiles).
+    code, _, _ = _post(port, _body("warmup"))
+    if code != 200:
+        fail(f"warm-up request returned {code}")
+
+    shed0 = sum(
+        s["value"] for s in metrics.REQUESTS_SHED.snapshot()["samples"]
+    )
+    dropped0 = metrics.REQUESTS_DROPPED.value()
+
+    # --- 1+2: the 32-burst at depth 4 -------------------------------------
+    results = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(BURST)
+
+    def client(i):
+        barrier.wait()
+        res = _post(port, _body(f"burst-{i}"))  # distinct bodies: no coalesce
+        with lock:
+            results.append(res)
+
+    threads = [
+        threading.Thread(target=client, args=(i,)) for i in range(BURST)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120.0)
+
+    if len(results) != BURST:
+        fail(f"only {len(results)}/{BURST} requests answered (hang/drop)")
+    codes = [code for code, _, _ in results]
+    bad = sorted({c for c in codes if c not in (200, 429)})
+    if bad:
+        fail(f"non-200/429 responses in burst: {bad} (zero 5xx required)")
+    n_ok = codes.count(200)
+    n_shed = codes.count(429)
+    for code, headers, payload in results:
+        if code == 429:
+            if int(headers.get("Retry-After", "0")) < 1:
+                fail(f"429 without a usable Retry-After: {headers}")
+            if payload.get("reason") not in ("queue_full", "deadline"):
+                fail(f"429 with unexpected reason: {payload}")
+
+    shed_metric = (
+        sum(s["value"] for s in metrics.REQUESTS_SHED.snapshot()["samples"])
+        - shed0
+    )
+    if shed_metric != n_shed:
+        fail(
+            f"osim_requests_shed_total moved by {shed_metric} but "
+            f"{n_shed} requests were shed"
+        )
+    if metrics.REQUESTS_DROPPED.value() != dropped0:
+        fail("osim_requests_dropped_total moved: a request was dropped")
+    if n_ok + n_shed != BURST:
+        fail(f"accounting mismatch: {n_ok} ok + {n_shed} shed != {BURST}")
+    print(
+        f"burst OK: {n_ok}x200 + {n_shed}x429 = {BURST}, "
+        f"shed metric matches, zero 5xx, zero drops"
+    )
+
+    # --- 3: expired deadline never enters simulate ------------------------
+    seen.clear()
+    doomed_result = []
+
+    def doomed_client():
+        doomed_result.append(
+            _post(
+                port, _body("doomed"), headers={"X-Osim-Deadline-Ms": "1"}
+            )
+        )
+
+    blocker = threading.Thread(
+        target=lambda: _post(port, _body("blocker"))
+    )
+    blocker.start()
+    if not blocker_started.wait(30.0):
+        fail("blocker request never entered simulate")
+    # the worker is now busy for 200 ms; a 1 ms deadline queued behind it
+    # must expire while waiting and be shed at dequeue
+    doomed = threading.Thread(target=doomed_client)
+    doomed.start()
+    doomed.join(60.0)
+    blocker.join(60.0)
+    if not doomed_result:
+        fail("deadline request never answered")
+    code, _, payload = doomed_result[0]
+    if code != 429 or payload.get("reason") != "deadline":
+        fail(f"expired deadline got {code} {payload}, wanted 429/deadline")
+    if "doomed" in seen:
+        fail("expired-deadline request ENTERED simulate")
+    print("deadline OK: expired request shed at dequeue, simulate untouched")
+
+    srv.shutdown()
+    srv.server_close()
+    print(
+        json.dumps(
+            {
+                "burst": BURST,
+                "queue_depth": DEPTH,
+                "ok": n_ok,
+                "shed": n_shed,
+                "dropped": 0,
+            }
+        )
+    )
+    print("load smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,555 @@
+"""Admission-control serving core (server/admission.py + server wiring).
+
+Sleep-free by construction: the AdmissionQueue takes an injectable clock
+and a synchronous `run_pending()` drain, so queue-full shedding, deadline
+propagation (shed-at-dequeue AND mid-flight watchdog abort), coalesced
+fan-out, and drain semantics are all provable without wall-clock waits —
+the same idiom as tests/test_resilience.py and tests/test_durable.py.
+The few tests that exercise the real worker thread synchronize on Events
+(no fixed sleeps).
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from open_simulator_tpu.resilience import faults
+from open_simulator_tpu.server import admission
+from open_simulator_tpu.server import server as server_mod
+from open_simulator_tpu.server.admission import AdmissionQueue, coalesce_key
+from open_simulator_tpu.utils import metrics
+
+
+class ManualClock:
+    """Monotonic-clock stand-in advanced explicitly by the test."""
+
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _recorder():
+    """Batch executor that records every batch and answers per body."""
+    calls = []
+
+    def execute(bodies):
+        calls.append(list(bodies))
+        return [{"echo": b} for b in bodies]
+
+    return execute, calls
+
+
+def _shed_count(reason: str) -> float:
+    return metrics.REQUESTS_SHED.value(reason=reason)
+
+
+# ---------------------------------------------------------------------------
+# queue-full shedding + Retry-After
+# ---------------------------------------------------------------------------
+
+
+def test_queue_full_shed_has_retry_after_from_service_time():
+    execute, _ = _recorder()
+    q = AdmissionQueue(
+        execute, depth=2, coalesce_ms=0.0, default_deadline_ms=0.0,
+        clock=ManualClock(), service_time_s=2.0,
+    )
+    before = _shed_count("queue_full")
+    t1 = q.submit({"a": 1}, key="k1")
+    t2 = q.submit({"a": 2}, key="k2")
+    t3 = q.submit({"a": 3}, key="k3")
+    assert not t1.done.is_set() and not t2.done.is_set()
+    assert t3.done.is_set()
+    assert t3.code == 429
+    assert t3.shed_reason == "queue_full"
+    # 2 queued ahead + this request, at 2 s/request observed service time
+    assert t3.headers["Retry-After"] == "6"
+    assert _shed_count("queue_full") == before + 1
+    # the queued pair still gets real answers
+    q.run_pending()
+    assert t1.code == 200 and t2.code == 200
+
+
+def test_queue_depth_resolved_from_env_at_construction(monkeypatch):
+    monkeypatch.setenv("OSIM_SERVER_QUEUE_DEPTH", "3")
+    monkeypatch.setenv("OSIM_SERVER_COALESCE_MS", "25")
+    monkeypatch.setenv("OSIM_SERVER_DEFAULT_DEADLINE_MS", "1500")
+    q = AdmissionQueue(lambda b: [], clock=ManualClock())
+    assert q.depth == 3
+    assert q.coalesce_s == pytest.approx(0.025)
+    assert q.default_deadline_ms == 1500.0
+
+
+def test_queue_depth_gauge_tracks_backlog():
+    execute, _ = _recorder()
+    q = AdmissionQueue(execute, depth=4, coalesce_ms=0.0, clock=ManualClock())
+    q.submit({"a": 1}, key="k1")
+    q.submit({"a": 2}, key="k2")
+    assert metrics.ADMISSION_QUEUE_DEPTH.value() == 2
+    q.run_pending()
+    assert metrics.ADMISSION_QUEUE_DEPTH.value() == 0
+
+
+# ---------------------------------------------------------------------------
+# deadline propagation
+# ---------------------------------------------------------------------------
+
+
+def test_expired_deadline_shed_at_dequeue_never_enters_execute():
+    execute, calls = _recorder()
+    clk = ManualClock()
+    q = AdmissionQueue(execute, depth=4, coalesce_ms=0.0, clock=clk)
+    before = _shed_count("deadline")
+    t = q.submit({"a": 1}, key="k", deadline_ms=50.0)
+    clk.advance(0.1)  # deadline passes while queued
+    q.run_pending()
+    assert t.code == 429
+    assert t.shed_reason == "deadline"
+    assert "Retry-After" in t.headers
+    assert calls == []  # acceptance: never entered a simulate call
+    assert _shed_count("deadline") == before + 1
+
+
+def test_default_deadline_applies_when_request_has_none():
+    clk = ManualClock()
+    q = AdmissionQueue(
+        lambda b: [{"ok": 1}] * len(b), depth=4, coalesce_ms=0.0,
+        default_deadline_ms=200.0, clock=clk,
+    )
+    t = q.submit({"a": 1}, key="k")
+    assert t.deadline_at == pytest.approx(0.2)
+    clk.advance(0.3)
+    q.run_pending()
+    assert t.shed_reason == "deadline"
+
+
+def test_midflight_deadline_aborts_via_watchdog_as_504():
+    clk = ManualClock()
+    release = threading.Event()
+    entered = []
+
+    def execute(bodies):
+        entered.append(len(bodies))
+        clk.advance(10.0)  # the simulate pass "takes" 10 s
+        release.wait(10.0)  # hold until the watchdog has fired
+        return [{"ok": 1}] * len(bodies)
+
+    q = AdmissionQueue(
+        execute, depth=4, coalesce_ms=0.0, clock=clk, watchdog_poll_s=0.001
+    )
+    fired_before = metrics.WATCHDOG_FIRED.value(stage="serve-simulate")
+    t = q.submit({"a": 1}, key="k", deadline_ms=500.0)
+    q.run_pending()
+    release.set()
+    assert entered == [1]  # deadline was live at dequeue, so it DID start
+    assert t.code == 504
+    assert "deadline" in t.payload["error"]
+    assert (
+        metrics.WATCHDOG_FIRED.value(stage="serve-simulate")
+        == fired_before + 1
+    )
+    # a mid-flight abort is NOT a shed: the request was admitted and run
+    assert t.shed_reason == ""
+
+
+def test_watchdog_budget_is_most_generous_live_deadline(monkeypatch):
+    """A stricter per-request budget would abort shared work other waiters
+    still have time for, so the batch runs under the max live deadline."""
+    budgets = []
+    real = admission.guarded_call
+
+    def spy(stage, fn, deadline_s, **kw):
+        budgets.append((stage, deadline_s))
+        return real(stage, fn, deadline_s, **kw)
+
+    monkeypatch.setattr(admission, "guarded_call", spy)
+    q = AdmissionQueue(
+        lambda bodies: [{"ok": 1}] * len(bodies),
+        depth=4, coalesce_ms=0.0, clock=ManualClock(),
+    )
+    q.submit({"a": 1}, key="k1", deadline_ms=300.0)
+    q.submit({"a": 2}, key="k2", deadline_ms=900.0)
+    q.run_pending()
+    assert budgets == [("serve-simulate", pytest.approx(0.9))]
+
+
+def test_watchdog_budget_unguarded_when_a_waiter_has_no_deadline(monkeypatch):
+    """A deadline-less waiter must not be aborted by a neighbor's budget;
+    the batch falls back to the global OSIM_CALL_DEADLINE_S (0 = off)."""
+    monkeypatch.delenv("OSIM_CALL_DEADLINE_S", raising=False)
+    budgets = []
+    real = admission.guarded_call
+
+    def spy(stage, fn, deadline_s, **kw):
+        budgets.append(deadline_s)
+        return real(stage, fn, deadline_s, **kw)
+
+    monkeypatch.setattr(admission, "guarded_call", spy)
+    q = AdmissionQueue(
+        lambda bodies: [{"ok": 1}] * len(bodies),
+        depth=4, coalesce_ms=0.0, clock=ManualClock(),
+    )
+    t1 = q.submit({"a": 1}, key="k1", deadline_ms=300.0)
+    t2 = q.submit({"a": 2}, key="k2")  # no deadline
+    q.run_pending()
+    assert budgets == [0.0]
+    assert t1.code == 200 and t2.code == 200
+
+
+# ---------------------------------------------------------------------------
+# coalescing
+# ---------------------------------------------------------------------------
+
+
+def test_coalesced_batch_fans_out_per_request_results():
+    execute, calls = _recorder()
+    q = AdmissionQueue(execute, depth=8, coalesce_ms=0.0, clock=ManualClock())
+    _, sum0, count0 = metrics.COALESCED_BATCH.child_state()
+    body = {"apps": [{"name": "web"}]}
+    t1 = q.submit(body, key="same")
+    t2 = q.submit(dict(body), key="same")
+    t3 = q.submit({"apps": []}, key="other")
+    q.run_pending()
+    # one executor entry per distinct key, in arrival order
+    assert calls == [[body, {"apps": []}]]
+    assert t1.code == t2.code == t3.code == 200
+    assert t1.payload == t2.payload == {"echo": body}
+    assert t3.payload == {"echo": {"apps": []}}
+    _, sum1, count1 = metrics.COALESCED_BATCH.child_state()
+    assert count1 - count0 == 2  # two coalesce groups observed
+    assert sum1 - sum0 == 3      # sizes 2 + 1
+
+
+def test_per_key_execute_failure_only_fails_that_keys_waiters():
+    def execute(bodies):
+        return [
+            ValueError("bad spec") if b.get("bad") else {"ok": 1}
+            for b in bodies
+        ]
+
+    q = AdmissionQueue(execute, depth=8, coalesce_ms=0.0, clock=ManualClock())
+    good = q.submit({"a": 1}, key="good")
+    bad1 = q.submit({"bad": 1}, key="bad")
+    bad2 = q.submit({"bad": 1}, key="bad")
+    q.run_pending()
+    assert good.code == 200
+    assert bad1.code == 400 and bad2.code == 400
+    assert "bad spec" in bad1.payload["error"]
+
+
+def test_executor_wide_failure_answers_every_waiter_400():
+    def execute(bodies):
+        raise RuntimeError("engine fell over")
+
+    q = AdmissionQueue(execute, depth=8, coalesce_ms=0.0, clock=ManualClock())
+    t1 = q.submit({"a": 1}, key="k1")
+    t2 = q.submit({"a": 2}, key="k2")
+    q.run_pending()
+    assert t1.code == 400 and t2.code == 400
+    assert "engine fell over" in t1.payload["error"]
+
+
+def test_result_count_mismatch_is_a_definite_400():
+    q = AdmissionQueue(
+        lambda bodies: [], depth=4, coalesce_ms=0.0, clock=ManualClock()
+    )
+    t = q.submit({"a": 1}, key="k")
+    q.run_pending()
+    assert t.code == 400
+    assert "0 results" in t.payload["error"]
+
+
+def test_coalesce_key_folds_path_body_and_generation():
+    body = {"apps": [{"name": "a"}]}
+    same = coalesce_key("/api/deploy-apps", dict(body))
+    assert coalesce_key("/api/deploy-apps", body) == same
+    assert coalesce_key("/api/scale-apps", body) != same
+    assert coalesce_key("/api/deploy-apps", {"apps": []}) != same
+    g1 = coalesce_key("/api/deploy-apps", body, generation=1)
+    g2 = coalesce_key("/api/deploy-apps", body, generation=2)
+    assert g1 != g2 and g1 != same
+
+
+def test_coalesce_window_holds_batch_open_for_late_arrivals():
+    """With a window, the worker waits out coalesce_ms from the head's
+    arrival before taking the batch (driven synchronously here via the
+    collect hook, with a real worker covered by the drain test below)."""
+    execute, calls = _recorder()
+    clk = ManualClock()
+    q = AdmissionQueue(execute, depth=8, coalesce_ms=50.0, clock=clk)
+    q.submit({"a": 1}, key="k1")
+    q.submit({"a": 2}, key="k2")
+    # run_pending drains synchronously regardless of the window — both
+    # arrivals land in ONE batch rather than two
+    q.run_pending()
+    assert len(calls) == 1 and len(calls[0]) == 2
+
+
+# ---------------------------------------------------------------------------
+# drain semantics
+# ---------------------------------------------------------------------------
+
+
+def test_drain_sheds_queued_but_not_in_flight_work():
+    started = threading.Event()
+    release = threading.Event()
+
+    def execute(bodies):
+        started.set()
+        assert release.wait(10.0)
+        return [{"ok": 1}] * len(bodies)
+
+    q = AdmissionQueue(execute, depth=8, coalesce_ms=0.0).start()
+    before = _shed_count("draining")
+    t_inflight = q.submit({"a": 1}, key="k1")
+    assert started.wait(10.0)  # worker is now executing t_inflight
+    t_queued1 = q.submit({"a": 2}, key="k2")
+    t_queued2 = q.submit({"a": 3}, key="k3")
+    q.shutdown()
+    # queued work: shed immediately with reason=draining + Retry-After
+    for t in (t_queued1, t_queued2):
+        assert t.done.is_set()
+        assert t.code == 503
+        assert t.shed_reason == "draining"
+        assert "Retry-After" in t.headers
+    assert _shed_count("draining") == before + 2
+    # in-flight work: completes and answers 200
+    release.set()
+    q.wait(t_inflight)
+    assert t_inflight.code == 200
+    q.join(10.0)
+    assert not q._worker.is_alive()
+    # post-drain submits are shed, not queued forever
+    t_late = q.submit({"a": 4}, key="k4")
+    assert t_late.shed_reason == "draining"
+
+
+def test_wait_answers_500_dropped_if_worker_died():
+    q = AdmissionQueue(
+        lambda b: [{"ok": 1}] * len(b), depth=4, clock=ManualClock()
+    )
+    q._worker = threading.Thread(target=lambda: None)  # never started
+    dropped_before = metrics.REQUESTS_DROPPED.value()
+    t = q.submit({"a": 1}, key="k")
+    q.wait(t, poll_s=0.001)
+    assert t.code == 500
+    assert "dropped" in t.payload["error"]
+    assert metrics.REQUESTS_DROPPED.value() == dropped_before + 1
+
+
+# ---------------------------------------------------------------------------
+# fault injection (target=admission)
+# ---------------------------------------------------------------------------
+
+
+def _plan(kind: str, op: str, **kw) -> faults.FaultPlan:
+    return faults.FaultPlan(
+        seed=0,
+        rules=[faults.FaultRule(target="admission", kind=kind, op=op, **kw)],
+    )
+
+
+def test_fault_queue_full_sheds_even_with_room():
+    execute, calls = _recorder()
+    q = AdmissionQueue(execute, depth=8, coalesce_ms=0.0, clock=ManualClock())
+    with faults.injected(_plan("queue_full", "submit", times=1)):
+        t1 = q.submit({"a": 1}, key="k1")
+        t2 = q.submit({"a": 2}, key="k2")
+    assert t1.code == 429 and t1.shed_reason == "queue_full"
+    assert not t2.done.is_set()  # rule exhausted after `times`
+    q.run_pending()
+    assert t2.code == 200
+    assert calls == [[{"a": 2}]]
+
+
+def test_fault_deadline_storm_expires_at_dequeue():
+    execute, calls = _recorder()
+    q = AdmissionQueue(execute, depth=8, coalesce_ms=0.0, clock=ManualClock())
+    with faults.injected(_plan("deadline_storm", "submit", times=1)):
+        t = q.submit({"a": 1}, key="k")
+        q.run_pending()
+    assert t.shed_reason == "deadline"
+    assert calls == []  # an already-expired deadline never reaches simulate
+
+
+def test_fault_slow_drain_injects_before_execute():
+    execute, _ = _recorder()
+    q = AdmissionQueue(execute, depth=8, coalesce_ms=0.0, clock=ManualClock())
+    with faults.injected(
+        _plan("slow_drain", "drain", latency_s=0.0)
+    ) as injector:
+        t = q.submit({"a": 1}, key="k")
+        q.run_pending()
+    assert t.code == 200  # zero-latency injection: observable, not harmful
+    assert injector.summary()[0]["injected"] == 1
+
+
+# ---------------------------------------------------------------------------
+# server wiring (env-freeze fix + HTTP front door)
+# ---------------------------------------------------------------------------
+
+
+def test_request_timeout_env_resolved_at_make_server_time(monkeypatch):
+    monkeypatch.setenv("OSIM_SERVER_REQUEST_TIMEOUT_S", "7")
+    srv = server_mod.make_server(0)
+    try:
+        assert server_mod.REQUEST_TIMEOUT_S == 7.0
+    finally:
+        srv.server_close()
+
+
+def test_monkeypatched_timeout_survives_when_env_absent(monkeypatch):
+    monkeypatch.delenv("OSIM_SERVER_REQUEST_TIMEOUT_S", raising=False)
+    monkeypatch.setattr(server_mod, "REQUEST_TIMEOUT_S", 0.25)
+    srv = server_mod.make_server(0)
+    try:
+        assert server_mod.REQUEST_TIMEOUT_S == 0.25
+    finally:
+        srv.server_close()
+
+
+def test_resync_env_resolved_at_serve_time(monkeypatch):
+    monkeypatch.setenv("OSIM_SERVER_RESYNC_S", "5")
+    monkeypatch.setattr(server_mod, "_resync_s", server_mod.RESYNC_SECONDS)
+    srv = server_mod.make_server(0)
+    try:
+        assert server_mod._resync_s == 5.0
+    finally:
+        srv.server_close()
+    assert server_mod.RESYNC_SECONDS == 30.0  # the parity constant is fixed
+
+
+@pytest.fixture
+def http_server(monkeypatch):
+    """Embedded server at queue depth 1 with a gated simulate, so overload
+    behavior is driven by Events rather than timing."""
+    release = threading.Event()
+    started = threading.Event()
+
+    def slow_simulate(body):
+        started.set()
+        assert release.wait(10.0)
+        return {"placements": {}, "unscheduled": []}
+
+    monkeypatch.setattr(server_mod, "_simulate_request", slow_simulate)
+    srv = server_mod.make_server(0, queue_depth=1, coalesce_ms=0.0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    port = srv.server_address[1]
+    yield port, release, started
+    release.set()
+    srv.shutdown()
+    srv.server_close()
+
+
+def _post(port, body, headers=None, timeout=10.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api/deploy-apps",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read() or b"{}")
+
+
+def test_http_burst_gets_definite_answers_and_retry_after(http_server):
+    port, release, started = http_server
+    results = []
+    lock = threading.Lock()
+
+    def client(i):
+        res = _post(port, {"apps": [], "i": i})
+        with lock:
+            results.append(res)
+
+    threads = [
+        threading.Thread(target=client, args=(i,)) for i in range(4)
+    ]
+    for t in threads:
+        t.start()
+    assert started.wait(10.0)  # one request is in flight...
+    release.set()              # ...then everything drains
+    for t in threads:
+        t.join(10.0)
+    codes = sorted(code for code, _, _ in results)
+    assert len(codes) == 4
+    assert set(codes) <= {200, 429}  # every answer definite, zero 5xx
+    assert codes.count(200) >= 1
+    for code, headers, payload in results:
+        if code == 429:
+            assert int(headers["Retry-After"]) >= 1
+            assert payload["reason"] == "queue_full"
+
+
+def test_http_invalid_deadline_header_is_400(http_server):
+    port, release, _ = http_server
+    release.set()
+    code, _, payload = _post(
+        port, {"apps": []}, headers={"X-Osim-Deadline-Ms": "soon"}
+    )
+    assert code == 400
+    assert "X-Osim-Deadline-Ms" in payload["error"]
+
+
+def test_server_close_sheds_queued_with_draining(monkeypatch):
+    release = threading.Event()
+    started = threading.Event()
+
+    def slow_simulate(body):
+        started.set()
+        assert release.wait(10.0)
+        return {"placements": {}, "unscheduled": []}
+
+    monkeypatch.setattr(server_mod, "_simulate_request", slow_simulate)
+    srv = server_mod.make_server(0, queue_depth=4, coalesce_ms=0.0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    port = srv.server_address[1]
+    results = []
+    lock = threading.Lock()
+
+    def client(i):
+        res = _post(port, {"apps": [], "i": i})
+        with lock:
+            results.append(res)
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(3)]
+    # stagger: the first request must be IN FLIGHT (worker blocked in
+    # simulate) before the others arrive, so they are provably queued
+    threads[0].start()
+    assert started.wait(10.0)
+    for t in threads[1:]:
+        t.start()
+    while len(srv.admission._queue) < 2:  # both followers enqueued
+        threading.Event().wait(0.005)
+    # SIGTERM path: stop accepting, shed the queue, drain in-flight. The
+    # drain blocks on the in-flight handler, so release it from a helper
+    # once the admission queue reports draining.
+    def _release_when_draining():
+        while not srv.admission.draining:
+            threading.Event().wait(0.01)
+        release.set()
+
+    helper = threading.Thread(target=_release_when_draining)
+    helper.start()
+    srv.shutdown()
+    srv.server_close()
+    helper.join(10.0)
+    for t in threads:
+        t.join(10.0)
+    codes = sorted(code for code, _, _ in results)
+    assert codes.count(200) == 1          # the in-flight request completed
+    for code, headers, payload in results:
+        if code == 503:
+            assert payload["reason"] == "draining"
+            assert "Retry-After" in headers
+    assert set(codes) == {200, 503}

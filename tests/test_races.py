@@ -5,7 +5,9 @@ Fixture packages are written to tmp_path and only parsed — never imported
 or executed — so snippets are free to spawn fake threads and handlers.
 The package-level tests pin the two server.py fixes this detector
 motivated: the _tracemalloc_on check-then-act now runs under
-_tracemalloc_lock, and _live_snapshot carries @guarded_by("_busy").
+_tracemalloc_lock, and the snapshot-cache refresh carries
+@guarded_by("_snapshot_lock") (formerly the POST _busy try-lock, removed
+when admission control landed).
 """
 
 import json
@@ -320,7 +322,10 @@ def test_live_snapshot_declares_its_lock():
     from open_simulator_tpu.server import server
     from open_simulator_tpu.utils.concurrency import GUARDED_BY_ATTR
 
-    assert getattr(server._live_snapshot, GUARDED_BY_ATTR) == "_busy"
+    assert (
+        getattr(server._refresh_snapshot_locked, GUARDED_BY_ATTR)
+        == "_snapshot_lock"
+    )
 
 
 def test_build_context_reuse_matches_fresh_run():
