@@ -487,6 +487,116 @@ def config_plan(n_pods=100_000, n_nodes=10_000):
     }
 
 
+def config_capacity_sweep(n_base=2, n_replicas=48):
+    """Config: serial-vs-batched capacity search on the same fixture, same
+    process. Required pod anti-affinity on hostname makes the demand-based
+    lower bound useless (estimate ~1 node, true answer ~replicas-base), so
+    the serial path must walk the full exponential bracket + bisection
+    (>=8 probe simulations) while the batched path (plan_capacity
+    sweep_mode=batched, docs/batching.md) closes the same bracket in <=3
+    vmapped device calls. `capacity_sweep_speedup` is the recorded
+    serial/batched wall-clock ratio."""
+    from open_simulator_tpu.engine.capacity import plan_capacity
+    from open_simulator_tpu.engine.simulator import (
+        AppResource,
+        ClusterResource,
+    )
+
+    anti = {
+        "affinity": {
+            "podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {
+                        "labelSelector": {"matchLabels": {"app": "lonely"}},
+                        "topologyKey": "kubernetes.io/hostname",
+                    }
+                ]
+            }
+        }
+    }
+
+    def fixture():
+        nodes = [_mk_node(f"n-{i}", "32", "64Gi") for i in range(n_base)]
+        deploys = [_mk_deploy("lonely", n_replicas, "500m", "1Gi",
+                              spec_extra=anti)]
+        cluster = ClusterResource(nodes=nodes)
+        apps = [AppResource(name="bench", objects=deploys)]
+        template = _mk_node("new-node", "32", "64Gi")
+        return cluster, apps, template
+
+    def one(mode):
+        from open_simulator_tpu.core.workloads import reset_name_rng
+
+        reset_name_rng()  # identical pod names => comparable searches
+        cluster, apps, template = fixture()
+        t0 = time.time()
+        plan = plan_capacity(cluster, apps, template, sweep_mode=mode)
+        return time.time() - t0, plan
+
+    serial_wall, serial_plan = one("serial")
+    batched_wall, batched_plan = one("batched")
+    out = {
+        "serial_wall_s": round(serial_wall, 2),
+        "batched_wall_s": round(batched_wall, 2),
+        "capacity_sweep_speedup": round(serial_wall / batched_wall, 2),
+        "serial_probes": serial_plan.attempts if serial_plan else -1,
+        "batched_calls": batched_plan.batched_calls if batched_plan else -1,
+        "nodes_added": batched_plan.nodes_added if batched_plan else -1,
+        "wall_s": round(serial_wall + batched_wall, 2),
+    }
+    if (serial_plan is None) != (batched_plan is None) or (
+        serial_plan is not None
+        and serial_plan.nodes_added != batched_plan.nodes_added
+    ):
+        out["error"] = (
+            f"serial/batched disagree: "
+            f"{serial_plan and serial_plan.nodes_added} vs "
+            f"{batched_plan and batched_plan.nodes_added}"
+        )
+    return out
+
+
+def config_multi_scenario(n_scenarios=64, n_nodes=64, n_pods=400):
+    """Config: one simulate_batch() call sweeping 64 what-if node-count
+    scenarios of a 400-pod workload — the scenario axis rides a single
+    vmapped program (docs/batching.md), so the sweep costs one compile and
+    one (bucketed) device call instead of 64 serial simulations.
+    `scenarios_per_second` sits next to `pods_per_second`: the former is
+    the sweep's own throughput, the latter counts every lane's pods."""
+    from open_simulator_tpu.engine.simulator import (
+        AppResource,
+        ClusterResource,
+        Scenario,
+        simulate_batch,
+    )
+
+    nodes = [_mk_node(f"n-{i}", "16", "32Gi") for i in range(n_nodes)]
+    deploys = [_mk_deploy("web", n_pods, "500m", "1Gi")]
+    cluster = ClusterResource(nodes=nodes)
+    apps = [AppResource(name="bench", objects=deploys)]
+    # node counts cycle over the top half so every lane keeps a distinct
+    # prefix of the cluster but all lanes share one padded node tensor
+    scenarios = [
+        Scenario(
+            name=f"s-{i}",
+            node_count=n_nodes // 2 + (i % (n_nodes // 2 + 1)),
+        )
+        for i in range(n_scenarios)
+    ]
+    t0 = time.time()
+    results = simulate_batch(cluster, apps, scenarios)
+    wall = time.time() - t0
+    placed = sum(len(st.pods) for r in results for st in r.node_status)
+    return {
+        "wall_s": round(wall, 2),
+        "scenarios": n_scenarios,
+        "scenarios_per_second": round(n_scenarios / wall, 2),
+        "pods_per_second": round(n_scenarios * n_pods / wall, 1),
+        "scheduled": placed,
+        "unscheduled": sum(len(r.unscheduled) for r in results),
+    }
+
+
 def config_preempt(n_nodes=60, n_low=400, n_high=100):
     """Config 6: priority-tiered preemption. A low-priority tier fills the
     cluster (400 x 1cpu on 60 x 8cpu = 80 cpu headroom), then a
@@ -744,7 +854,7 @@ def config_serving_concurrent(
         ok_lat = sorted(lat for code, lat in outcomes if code == 200)
         shed = sum(1 for code, _ in outcomes if code in (429, 503))
         other = total - len(ok_lat) - shed
-        _, co_sum, co_count = metrics.COALESCED_BATCH.child_state()
+        _, co_sum, co_count = metrics.COALESCED_BATCH.child_state(mode="fanout")
         shed_by_reason = {
             s["labels"]["reason"]: int(s["value"])
             for s in metrics.REQUESTS_SHED.snapshot()["samples"]
@@ -794,6 +904,8 @@ CONFIGS = {
     "spread_aff_10k_1k": config_spread_affinity,
     "gpushare_5k": config_gpushare,
     "plan_100k_10k": config_plan,
+    "capacity_sweep_batched": config_capacity_sweep,
+    "multi_scenario_64": config_multi_scenario,
     "preempt_tiered": config_preempt,
     "extender_1k": config_extender,
     "serving_concurrent": config_serving_concurrent,
@@ -907,6 +1019,8 @@ SEGMENT_TIMEOUT_S = {
     "spread_aff_10k_1k": 900.0,
     "gpushare_5k": 900.0,
     "plan_100k_10k": 1200.0,
+    "capacity_sweep_batched": 900.0,
+    "multi_scenario_64": 600.0,
     "preempt_tiered": 900.0,
     "extender_1k": 900.0,
     "serving_concurrent": 600.0,
@@ -1124,6 +1238,23 @@ def main() -> int:
             result = _run_headline(args.pods, args.nodes)
             if journal is not None:
                 journal.append("segment", segment="headline", result=result)
+        # The serial-vs-batched capacity sweep is cheap enough to keep in
+        # the quick profile, and the speedup ratio is only meaningful when
+        # both paths run in the same process (shared caches, same backend).
+        if "capacity_sweep_batched" in done_segments:
+            print(
+                "bench segment capacity_sweep_batched: replayed from journal",
+                file=sys.stderr, flush=True,
+            )
+            sweep = dict(done_segments["capacity_sweep_batched"])
+        else:
+            sweep = config_capacity_sweep()
+            if journal is not None and "error" not in sweep:
+                journal.append(
+                    "segment", segment="capacity_sweep_batched", result=sweep
+                )
+        result["capacity_sweep_batched"] = sweep
+        result["capacity_sweep_speedup"] = sweep.get("capacity_sweep_speedup")
         result.update(backend_info)
         from open_simulator_tpu.utils.metrics import COMPILE_CACHE, REGISTRY
 

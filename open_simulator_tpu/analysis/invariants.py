@@ -6,7 +6,7 @@ canonical bucketed shapes the jaxpr auditor traces:
 
   * masks stay {0,1}-valued (bool dtype all the way to the entry outputs),
   * every score plugin lands in [0,100] (kube's checkPluginScores contract),
-  * no float output of any of the 12 jit entries can be NaN, and
+  * no float output of any of the 13 jit entries can be NaN, and
   * the deliberate ``-inf * 0.0 → NaN`` sentinel pattern (fast.py's score
     lanes carry -inf on infeasible nodes) can never reach a selection point
     — argmax/argmin/reduce_max/reduce_min/sort operands are proven NaN-free.
@@ -1031,7 +1031,7 @@ class InvariantAudit:
 
 
 def run_invariants() -> InvariantAudit:
-    """Retrace the 12 canonical jit entries + the 10 score plugins and
+    """Retrace the 13 canonical jit entries + the 10 score plugins and
     abstractly interpret every jaxpr. Deterministic given the canonical
     state (the same one the jaxpr auditor uses)."""
     from . import jaxpr_audit as ja

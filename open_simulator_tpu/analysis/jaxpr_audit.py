@@ -66,6 +66,7 @@ AUDIT_TARGETS: Dict[str, Tuple[str, ...]] = {
         "light_reasons",
         "gather_takes",
         "exit_carry",
+        "schedule_scenarios",
     ),
     "open_simulator_tpu.ops.grouped": ("_group_jit",),
     "open_simulator_tpu.ops.kernels": ("schedule_batch", "probe_step", "commit_step"),
@@ -83,6 +84,7 @@ REQUIRED_COVERAGE = frozenset(
         "ops.fast:cur_at",
         "ops.fast:gather_takes",
         "ops.fast:exit_carry",
+        "ops.fast:schedule_scenarios",
         "ops.grouped:_group_jit",
         "ops.kernels:schedule_batch",
         "ops.kernels:probe_step",
@@ -345,6 +347,18 @@ def _capture_calls() -> List[_Captured]:
         row0 = _tree_first(rows)
         kernels.probe_step(ns, carry, row0, weights)
         kernels.commit_step(ns, carry, row0, jnp.int32(0))
+        # the batched scenario engine (`schedule_scenarios`): a 2-lane
+        # what-if sweep padded to the scenario bucket, the exact shapes
+        # Simulator.run_scenarios ships (lane 1 masks off half the nodes;
+        # pad lanes are copies of lane 0, as in production)
+        s_pad = fast.scenario_bucket(2)
+        valid_s = jnp.stack([ns.valid] * s_pad)
+        valid_s = valid_s.at[1, 12:].set(False)
+        weights_s = jnp.stack([weights] * s_pad)
+        fast.schedule_scenarios_host(
+            ns, state_mod.stack_carry(carry, s_pad), batch,
+            weights_s, valid_s, 2,
+        )
         del np
     finally:
         for module, attr, original in patches:
@@ -427,6 +441,13 @@ def run_audit() -> AuditReport:
 # recompile guard
 
 
+#: max distinct scenario-axis paddings per (node bucket, pod count) program
+#: key: the batched capacity search shapes its lanes to the scenario bucket,
+#: so a bucket should see at most {ladder pad, refine pad} — more means the
+#: lane shaping regressed and every sweep call recompiles.
+SCENARIO_PROGRAMS_PER_BUCKET = 2
+
+
 @dataclasses.dataclass
 class GuardResult:
     compiles: int
@@ -434,12 +455,25 @@ class GuardResult:
     metric_compiles: int
     nodes_added: int
     attempts: int
+    batched_calls: int = 0
+    batched_nodes_added: int = -1
+    scenario_programs: Dict[str, List[int]] = dataclasses.field(
+        default_factory=dict
+    )
+
+    @property
+    def scenario_ok(self) -> bool:
+        return self.batched_nodes_added == self.nodes_added and all(
+            len(pads) <= SCENARIO_PROGRAMS_PER_BUCKET
+            for pads in self.scenario_programs.values()
+        )
 
     @property
     def ok(self) -> bool:
         return (
             0 < self.compiles <= self.budget
             and self.compiles == self.metric_compiles
+            and self.scenario_ok
         )
 
     def to_dict(self) -> dict:
@@ -450,15 +484,25 @@ class GuardResult:
             "metric_compiles": self.metric_compiles,
             "nodes_added": self.nodes_added,
             "attempts": self.attempts,
+            "batched_calls": self.batched_calls,
+            "batched_nodes_added": self.batched_nodes_added,
+            "scenario_programs": self.scenario_programs,
+            "scenario_ok": self.scenario_ok,
         }
 
     def render_text(self) -> str:
+        worst = max(
+            (len(p) for p in self.scenario_programs.values()), default=0
+        )
         return (
             f"recompile guard: {'ok' if self.ok else 'FAILED'} — "
             f"{self.compiles} backend compiles (budget {self.budget}, "
             f"metric cross-check {self.metric_compiles}) over a capacity "
             f"sweep adding {self.nodes_added} node(s) in {self.attempts} "
-            "probes"
+            f"probes; batched sweep: {self.batched_calls} call(s), "
+            f"{worst} scenario program(s)/bucket "
+            f"(max {SCENARIO_PROGRAMS_PER_BUCKET}), answer "
+            f"{'agrees' if self.batched_nodes_added == self.nodes_added else 'DISAGREES'}"
         )
 
 
@@ -540,10 +584,27 @@ def run_recompile_guard(budget: int = RECOMPILE_BUDGET) -> GuardResult:
     from jax import monitoring
 
     monitoring.register_event_duration_secs_listener(_local_listener)
+    from ..core.workloads import reset_name_rng
+    from ..ops.fast import reset_scenario_programs, scenario_programs
+
     metric_before = _backend_compiles()
+    reset_scenario_programs()
     try:
+        reset_name_rng()
         cluster, apps, template = _sweep_fixture()
-        plan = plan_capacity(cluster, apps, template, max_new_nodes=256)
+        plan = plan_capacity(
+            cluster, apps, template, max_new_nodes=256, sweep_mode="serial"
+        )
+        # the batched half: same fixture through the vmapped scenario
+        # engine, which must (a) reach the same answer and (b) keep every
+        # (node bucket, pod count) program key within its scenario-padding
+        # budget — one padding per sweep phase, not one per call
+        reset_name_rng()
+        cluster_b, apps_b, template_b = _sweep_fixture()
+        plan_b = plan_capacity(
+            cluster_b, apps_b, template_b, max_new_nodes=256,
+            sweep_mode="batched",
+        )
     finally:
         try:
             monitoring._unregister_event_duration_listener_by_callback(
@@ -551,7 +612,7 @@ def run_recompile_guard(budget: int = RECOMPILE_BUDGET) -> GuardResult:
             )
         except Exception:
             pass
-    if plan is None:
+    if plan is None or plan_b is None:
         raise RuntimeError("recompile-guard sweep did not converge")
     metric_delta = _backend_compiles() - metric_before
     return GuardResult(
@@ -560,6 +621,12 @@ def run_recompile_guard(budget: int = RECOMPILE_BUDGET) -> GuardResult:
         metric_compiles=metric_delta,
         nodes_added=plan.nodes_added,
         attempts=plan.attempts,
+        batched_calls=plan_b.batched_calls,
+        batched_nodes_added=plan_b.nodes_added,
+        scenario_programs={
+            f"{n}x{p}": sorted(pads)
+            for (n, p), pads in scenario_programs().items()
+        },
     )
 
 

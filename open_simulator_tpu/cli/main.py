@@ -154,13 +154,13 @@ def _run_runs(args) -> int:
             print(f"  [{e['seq']:>4}] {e['event']:<18} {_json.dumps(extra, sort_keys=True)}")
         return 0
 
-    # resume: only apply runs are resumable from the CLI (bench has its own
-    # entry point: `python bench.py --resume RUN_DIR`)
-    if summary["kind"] != "apply":
+    # resume: apply and sweep runs are resumable from the CLI (bench has its
+    # own entry point: `python bench.py --resume RUN_DIR`)
+    if summary["kind"] not in ("apply", "sweep"):
         print(
             f"error: run {args.run_dir} is kind={summary['kind'] or '?'}; "
-            "`simon runs resume` handles apply runs — resume bench runs "
-            "with `python bench.py --resume RUN_DIR`",
+            "`simon runs resume` handles apply and sweep runs — resume "
+            "bench runs with `python bench.py --resume RUN_DIR`",
             file=sys.stderr,
         )
         return 1
@@ -171,6 +171,15 @@ def _run_runs(args) -> int:
             file=sys.stderr,
         )
         return 1
+    if summary["kind"] == "sweep":
+        import argparse as _argparse
+
+        start = (replay(args.run_dir) or [{}])[0]
+        return _run_sweep(_argparse.Namespace(
+            simon_config=config_path, capacity=True, node_counts="",
+            use_greed=bool(start.get("use_greed")), format="text",
+            run_dir=args.run_dir, resume=True,
+        ))
     from ..api.config import SimonConfig
     from ..engine.apply import ApplyError, run_apply
 
@@ -183,6 +192,218 @@ def _run_runs(args) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 1
     return 0 if not outcome.result.unscheduled else 2
+
+
+def _add_sweep(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "sweep",
+        help="batched multi-scenario simulation (one vmapped device call)",
+        description=(
+            "Evaluate many what-if scenarios of one simon config through "
+            "the batched scenario engine (docs/batching.md): every lane "
+            "shares the encoded cluster and one compiled program, so a "
+            "whole sweep costs one (or log-few) device calls instead of "
+            "one simulation per scenario. `--node-counts` compares cluster "
+            "sizes (each lane keeps only the first N nodes); `--capacity` "
+            "runs the batched minimum-node capacity search against the "
+            "config's newNode candidate, with the same journal/resume "
+            "contract as `simon apply` (docs/durability.md)."
+        ),
+    )
+    p.add_argument(
+        "-f", "--simon-config", required=True, help="path of simon config"
+    )
+    p.add_argument(
+        "--node-counts", default="",
+        help="comma list of node counts; one scenario per count, each "
+        "keeping only the first N cluster nodes (e.g. 4,8,16)",
+    )
+    p.add_argument(
+        "--capacity", action="store_true",
+        help="batched capacity search: minimum clones of the config's "
+        "newNode so everything schedules (plan_capacity sweep_mode=batched)",
+    )
+    p.add_argument(
+        "--use-greed", action="store_true",
+        help="order pods by descending dominant resource share "
+        "(forces the serial fallback for node-count sweeps: greed ordering "
+        "depends on the lane's node set)",
+    )
+    p.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output format",
+    )
+    p.add_argument(
+        "--run-dir", default="",
+        help="journal a --capacity sweep into this directory (each batched "
+        "call commits a `sweep` record with all lane verdicts)",
+    )
+    p.add_argument(
+        "--resume", action="store_true",
+        help="resume a journaled --capacity sweep: journaled sweep records "
+        "replay with zero re-run scenarios",
+    )
+
+
+def _run_sweep(args) -> int:
+    import json as _json
+    import time as _time
+
+    from ..api.config import SimonConfig
+    from ..engine.apply import (
+        ApplyError,
+        build_apps,
+        build_cluster,
+        load_new_node,
+    )
+    from ..engine.simulator import Scenario, simulate_batch
+
+    try:
+        cfg = SimonConfig.load(args.simon_config)
+        cluster = build_cluster(cfg)
+        apps = build_apps(cfg)
+    except (ApplyError, ValueError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+    if args.capacity:
+        from ..engine.capacity import plan_capacity
+
+        new_node = load_new_node(cfg)
+        if new_node is None:
+            print(
+                "error: --capacity needs a newNode candidate in the config",
+                file=sys.stderr,
+            )
+            return 1
+        journal = None
+        if args.run_dir:
+            from ..durable import RunJournal
+
+            journal = RunJournal.open(args.run_dir)
+            if args.resume:
+                journal.append("run_resume")
+            else:
+                journal.append(
+                    "run_start", kind="sweep",
+                    simon_config=args.simon_config,
+                    use_greed=bool(args.use_greed),
+                )
+        elif args.resume:
+            print("error: --resume needs --run-dir", file=sys.stderr)
+            return 1
+        t0 = _time.monotonic()
+        plan = plan_capacity(
+            cluster, apps, new_node, use_greed=args.use_greed,
+            journal=journal, resume=args.resume, sweep_mode="batched",
+        )
+        wall = _time.monotonic() - t0
+        if journal is not None:
+            import os as _os
+
+            from ..durable import atomic_write
+            from ..engine.apply import placement_digest
+
+            journal.append(
+                "run_end",
+                outcome="ok" if plan is not None else "does_not_fit",
+                nodes_added=plan.nodes_added if plan else -1,
+            )
+            # timestamp-free snapshot (mirrors run_apply's outcome.json):
+            # a SIGKILL'd-then-resumed sweep must byte-match an
+            # uninterrupted one — the crash-resume smoke `cmp`s these
+            atomic_write(
+                _os.path.join(journal.run_dir, "outcome.json"),
+                _json.dumps(
+                    {
+                        "outcome": "ok" if plan else "does_not_fit",
+                        "kind": "sweep",
+                        "nodes_added": plan.nodes_added if plan else -1,
+                        "attempts": plan.attempts if plan else 0,
+                        "batched_calls": plan.batched_calls if plan else 0,
+                        "retries": plan.retries if plan else 0,
+                        "unscheduled": (
+                            len(plan.result.unscheduled) if plan else -1
+                        ),
+                        "placement_digest": (
+                            placement_digest(plan.result) if plan else ""
+                        ),
+                    },
+                    indent=2,
+                    sort_keys=True,
+                )
+                + "\n",
+            )
+            journal.close()
+        if plan is None:
+            print("capacity sweep failed: workload does not fit", file=sys.stderr)
+            return 2
+        doc = {
+            "nodes_added": plan.nodes_added,
+            "attempts": plan.attempts,
+            "batched_calls": plan.batched_calls,
+            "retries": plan.retries,
+            "wall_s": round(wall, 3),
+        }
+        if args.format == "json":
+            print(_json.dumps(doc, indent=2, sort_keys=True))
+        else:
+            print(
+                f"capacity sweep: add {plan.nodes_added} x {new_node.name} "
+                f"({plan.attempts} scenario verdicts in "
+                f"{plan.batched_calls} batched call(s), {wall:.2f}s)"
+            )
+        return 0
+
+    try:
+        counts = [
+            int(s) for s in args.node_counts.split(",") if s.strip()
+        ]
+    except ValueError:
+        print(
+            f"error: --node-counts must be a comma list of integers, got "
+            f"{args.node_counts!r}", file=sys.stderr,
+        )
+        return 1
+    if not counts:
+        print(
+            "error: pass --node-counts or --capacity (nothing to sweep)",
+            file=sys.stderr,
+        )
+        return 1
+    scenarios = [Scenario(name=f"nodes-{k}", node_count=k) for k in counts]
+    t0 = _time.monotonic()
+    try:
+        results = simulate_batch(
+            cluster, apps, scenarios, use_greed=args.use_greed
+        )
+    except ValueError as e:  # e.g. a count outside [0, n_nodes]
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    wall = _time.monotonic() - t0
+    rows = []
+    for sc, res in zip(scenarios, results):
+        placed = sum(len(st.pods) for st in res.node_status)
+        rows.append({
+            "scenario": sc.name,
+            "nodes": sc.node_count,
+            "pods_placed": placed,
+            "unscheduled": len(res.unscheduled),
+        })
+    if args.format == "json":
+        print(_json.dumps(
+            {"scenarios": rows, "wall_s": round(wall, 3)},
+            indent=2, sort_keys=True,
+        ))
+    else:
+        print(f"{'SCENARIO':<16} {'NODES':>6} {'PLACED':>8} {'UNSCHEDULED':>12}")
+        for r in rows:
+            print(
+                f"{r['scenario']:<16} {r['nodes']:>6} {r['pods_placed']:>8} "
+                f"{r['unscheduled']:>12}"
+            )
+        print(f"{len(rows)} scenario(s) in {wall:.2f}s (one batched sweep)")
+    return 0 if all(r["unscheduled"] == 0 for r in rows) else 2
 
 
 def _add_lint(sub: argparse._SubParsersAction) -> None:
@@ -472,6 +693,7 @@ def main(argv=None) -> int:
     _add_chaos(sub)
     _add_lint(sub)
     _add_runs(sub)
+    _add_sweep(sub)
     ps = sub.add_parser(
         "server", help="run the REST simulation service",
         description="run the REST simulation service",
@@ -512,14 +734,14 @@ def main(argv=None) -> int:
     pd.add_argument("--output-dir", default="./docs/commandline")
 
     args = parser.parse_args(argv)
-    if args.command in ("apply", "chaos", "server", "runs"):
+    if args.command in ("apply", "chaos", "server", "runs", "sweep"):
         from ..utils.platform import enable_compilation_cache, ensure_platform
         from ..utils.tracing import init_logging
 
         init_logging()  # LogLevel env, parity: cmd/simon/simon.go:46-66
         ensure_platform()
         enable_compilation_cache()
-    if args.command in ("apply", "server", "runs"):
+    if args.command in ("apply", "server", "runs", "sweep"):
         # honor OSIM_FAULT_PLAN for non-chaos entry points too (chaos does
         # its own install): docs/resilience.md promises env-driven plans,
         # and the crash-resume smoke injects its deterministic SIGKILL into
@@ -544,6 +766,8 @@ def main(argv=None) -> int:
         return _run_audit(args)
     if args.command == "lint":
         return _run_lint(args)
+    if args.command == "sweep":
+        return _run_sweep(args)
     if args.command == "gen-doc":
         return _gen_doc(parser, args.output_dir)
     if args.command == "server":

@@ -15,13 +15,33 @@ from __future__ import annotations
 
 import copy
 import os
+import time
 from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
 
 from ..core.objects import LABEL_NEW_NODE, Node
 from ..utils import metrics
 from ..utils.tracing import span
-from .simulator import AppResource, ClusterResource, SimulateResult, simulate
+from .simulator import (
+    AppResource,
+    ClusterResource,
+    Scenario,
+    ScenarioOutcome,
+    SimulateResult,
+    Simulator,
+    batch_ineligible_reason,
+    simulate,
+)
+
+# Batched-sweep lane shaping: the exponential ladder probes LADDER_LANES
+# doubling counts per device call; bracket refinement evaluates up to
+# SWEEP_LANES interior candidates per call. Both match the scenario bucket
+# (ops.fast.SCENARIO_BUCKET) exactly so every phase pads to S=8 — together
+# with the refine phase reusing the ladder's node bucket, the entire
+# batched search runs one compiled program. A bracket of width ≤ 8 closes
+# exactly in one refine call; wider brackets narrow ~9x per call.
+LADDER_LANES = 8
+SWEEP_LANES = 8
 
 def new_fake_nodes(template: Node, count: int) -> List[Node]:
     """Clone the candidate node `count` times as simon-NNNNN with the new-node
@@ -80,6 +100,32 @@ def satisfy_resource_setting(result: SimulateResult) -> bool:
     return cpu_ok and mem_ok and vg_ok
 
 
+def satisfy_outcome(out: ScenarioOutcome) -> bool:
+    """satisfy_resource_setting over a verdict-mode lane's totals — the same
+    int() truncation and strict '>' comparison, fed by ScenarioOutcome sums
+    that Simulator._scenario_outcomes builds to mirror exactly what
+    satisfy_resource_setting would read off the materialized result."""
+    max_cpu, max_mem, max_vg = max_resource_limits()
+    if max_cpu >= 100 and max_mem >= 100 and max_vg >= 100:
+        return True
+    cpu_ok = (
+        out.cpu_alloc == 0
+        or int(100.0 * out.cpu_req / out.cpu_alloc) <= max_cpu
+    )
+    mem_ok = (
+        out.mem_alloc == 0
+        or int(100.0 * out.mem_req / out.mem_alloc) <= max_mem
+    )
+    vg_ok = out.vg_cap == 0 or int(100.0 * out.vg_req / out.vg_cap) <= max_vg
+    return cpu_ok and mem_ok and vg_ok
+
+
+def _good_outcome(out: ScenarioOutcome) -> bool:
+    """The batched analog of plan_capacity's good(): everything scheduled and
+    the utilization gates pass."""
+    return out.unscheduled == 0 and satisfy_outcome(out)
+
+
 @dataclass
 class CapacityPlan:
     nodes_added: int
@@ -88,6 +134,9 @@ class CapacityPlan:
     # probes re-run because a transient extender failure (not a scheduling
     # verdict) left pods unscheduled — nonzero means the search ran degraded
     retries: int = 0
+    # batched (vmapped multi-scenario) device calls the search issued; 0 on
+    # the serial bisection path
+    batched_calls: int = 0
 
 
 class _TransientTrialError(Exception):
@@ -170,9 +219,22 @@ def plan_capacity(
     extenders=None,
     journal=None,
     resume: bool = False,
+    sweep_mode: str = "auto",
 ) -> Optional[CapacityPlan]:
     """Minimum clones of `new_node` so every pod schedules and utilization
     gates pass. Returns None if even max_new_nodes doesn't suffice.
+
+    `sweep_mode`: "batched" evaluates whole ladders of node counts per
+    device call through the vmapped scenario engine
+    (Simulator.run_scenarios) — log₂-few batched calls instead of ~11
+    serial probes; "serial" is the probe-at-a-time bisection; "auto"
+    (default) picks batched whenever the workload is batch-eligible
+    (see simulator.batch_ineligible_reason — extenders, profiles, mesh,
+    plugins, greed ordering, DaemonSets, and preemption-eligible pods all
+    force serial, whose per-scenario control flow a vmapped lane cannot
+    reproduce). Both modes return identical plans: the batched verdict
+    lanes run the same commit engine the serial path proves bit-identity
+    against, and the winning count is re-materialized serially either way.
 
     Durability: with a `journal` (durable.RunJournal), every trial's verdict
     is committed as a `trial` record *after* it completes, and with
@@ -183,14 +245,26 @@ def plan_capacity(
     run never finished, plus one `final` materializing replay — which is
     journaled as `final`, not `trial`, and never counted in
     `CapacityPlan.attempts`, so attempts/retries are identical between an
-    interrupted+resumed sweep and an uninterrupted one."""
+    interrupted+resumed sweep and an uninterrupted one. The batched path
+    journals one `sweep` record per device call carrying ALL lane verdicts,
+    consumed FIFO on resume — a SIGKILL'd batched search resumes with zero
+    re-run scenarios. A resumed run always replays the crashed run's search
+    shape: journaled `sweep` records force batched mode, journaled non-base
+    `trial` records force serial, regardless of `sweep_mode`."""
 
+    from ..durable.watchdog import call_deadline_s, guarded_call
     from ..ops.encode import round_up
     from ..resilience.policy import RetryExhaustedError, RetryPolicy
     from ..utils.tracing import log
 
+    if sweep_mode not in ("auto", "serial", "batched"):
+        raise ValueError(
+            f"sweep_mode must be auto|serial|batched, got {sweep_mode!r}"
+        )
+
     attempts = 0
     retries = 0
+    batched_calls = 0
     n_base = len(cluster.nodes)
     # Workload expansion/validation is node-independent for everything but
     # DaemonSets — one shared cache expands the 100k-pod workload once for
@@ -201,11 +275,41 @@ def plan_capacity(
     # for a transport timeout would mis-size the cluster.
     trial_policy = RetryPolicy.from_env(max_attempts=2)
 
-    # node_count -> FIFO of journaled trial records from the crashed run(s)
+    # node_count -> FIFO of journaled trial records from the crashed run(s);
+    # sweep_cache: FIFO of journaled batched-sweep records
     resume_cache: dict = {}
+    sweep_cache: list = []
     if resume and journal is not None:
         for e in journal.events("trial"):
             resume_cache.setdefault(int(e["node_count"]), []).append(e)
+        sweep_cache = list(journal.events("sweep"))
+
+    # Resolve the search shape. A resume MUST replay the crashed run's shape
+    # (the journal's verdicts only line up with the search that produced
+    # them); otherwise "auto" takes the batched path whenever the workload
+    # is batch-eligible.
+    mode = sweep_mode
+    if resume and journal is not None:
+        if sweep_cache:
+            mode = "batched"
+        elif any(
+            int(e.get("node_count", 0)) > 0 for e in journal.events("trial")
+        ):
+            mode = "serial"
+    if mode != "serial":
+        reason = batch_ineligible_reason(
+            cluster, apps, [Scenario(node_count=0)], use_greed=use_greed,
+            mesh=mesh, profiles=profiles, extenders=extenders,
+        )
+        if reason is not None:
+            if mode == "batched":
+                log.warning(
+                    "plan_capacity: batched sweep unavailable (%s); "
+                    "using serial bisection", reason,
+                )
+            mode = "serial"
+        else:
+            mode = "batched"
 
     # seed for the exponential phase's first hi (demand/supply estimate);
     # journaled with the base trial so a resume never needs the base result
@@ -323,12 +427,148 @@ def plan_capacity(
                 )
         return res
 
+    def sweep(counts: List[int], n_pad_sweep: int, phase: str):
+        """One batched device call — verdicts for a whole ladder of node
+        counts at once — or its journal replay on resume. Each lane k is the
+        base cluster plus the first k clones of the max-count trial cluster
+        (Scenario.node_count masks the rest; masked rows are inert in every
+        kernel). Returns [good?] aligned with counts, or None when the
+        post-expansion gate in run_scenarios refused (preemption-eligible
+        pods) — the caller falls back to serial before anything was
+        journaled, so resume shape stays consistent."""
+        nonlocal attempts, batched_calls
+        counts = list(counts)
+        if sweep_cache:
+            e = sweep_cache.pop(0)
+            if list(map(int, e.get("counts", []))) == counts:
+                attempts += len(counts)
+                batched_calls += 1
+                return [bool(g) for g in e.get("good", [])]
+            # The journaled search diverged from the planned one (e.g. env
+            # utilization limits changed between runs): the remaining
+            # records can't line up either — go fully live from here.
+            log.warning(
+                "plan_capacity resume: journaled sweep counts %s do not "
+                "match planned %s; discarding remaining sweep records and "
+                "re-running live", e.get("counts"), counts,
+            )
+            sweep_cache.clear()
+        trial = ClusterResource(
+            nodes=list(cluster.nodes) + new_fake_nodes(new_node, max(counts)),
+            pods=list(cluster.pods),
+            daemonsets=list(cluster.daemonsets),
+            others=dict(cluster.others),
+        )
+        scenarios = [
+            Scenario(name=f"+{k}", node_count=n_base + k) for k in counts
+        ]
+        metrics.CAPACITY_PROBES.inc(len(counts))
+        t0 = time.monotonic()
+        with span("capacity-sweep", lanes=len(counts), phase=phase):
+            outs = guarded_call(
+                "capacity-sweep",
+                lambda: Simulator(
+                    trial, weights=weights, use_greed=use_greed,
+                    n_pad=n_pad_sweep, expand_cache=expand_cache,
+                ).run_scenarios(apps, scenarios, materialize=False),
+                call_deadline_s(),
+            )
+        if outs is None:
+            return None
+        metrics.BATCH_SWEEP_DURATION.observe(time.monotonic() - t0)
+        verdicts = [_good_outcome(o) for o in outs]
+        attempts += len(counts)
+        batched_calls += 1
+        if journal is not None:
+            journal.append(
+                "sweep", phase=phase, counts=counts, good=verdicts,
+                n_pad=n_pad_sweep,
+            )
+        return verdicts
+
     g0, base = probe(0)
     if g0:
         if base is None:
             base = finalize(0, None)
         metrics.CAPACITY_NODES_ADDED.set(0)
         return CapacityPlan(0, base, attempts, retries)
+
+    if mode == "batched":
+        # --- batched ladder: geometric counts, LADDER_LANES per call -------
+        # Same bracket the serial exponential phase walks probe-by-probe,
+        # evaluated as whole device calls; the demand/supply seed skips most
+        # low counts exactly as it does serially.
+        ladder = []
+        k = seed_hi or 1
+        while k <= max_new_nodes:
+            ladder.append(k)
+            k *= 2
+        hi: Optional[int] = None
+        lo = 0
+        fell_back = False
+        n_pad_ladder = 0
+        for start in range(0, len(ladder), LADDER_LANES):
+            chunk = ladder[start:start + LADDER_LANES]
+            n_pad_ladder = round_up(n_base + chunk[-1], 64)
+            verdicts = sweep(chunk, n_pad_ladder, "ladder")
+            if verdicts is None:
+                fell_back = True
+                break
+            goods = [c for c, g in zip(chunk, verdicts) if g]
+            if goods:
+                hi = min(goods)
+                lo = max(
+                    [lo] + [c for c, g in zip(chunk, verdicts)
+                            if not g and c < hi]
+                )
+                break
+            lo = max([lo] + chunk)
+        if fell_back:
+            log.warning(
+                "plan_capacity: workload has preemption-eligible pods; "
+                "batched sweep cannot reproduce per-scenario preemption — "
+                "using serial bisection"
+            )
+            mode = "serial"
+        elif hi is None:
+            return None  # the whole ladder failed: workload does not fit
+        else:
+            # --- batched refinement: close (lo, hi] ------------------------
+            # Up to SWEEP_LANES interior candidates per call, every call
+            # pinned to the LADDER's node bucket: the refine counts all sit
+            # below the ladder chunk that bracketed them, so its bucket
+            # covers every trial cluster and the whole batched search —
+            # ladder and refinement — reuses one compiled program (the
+            # recompile guard asserts ≤ 2 per bucket).
+            n_pad_refine = n_pad_ladder
+            while hi - lo > 1 and not fell_back:
+                width = hi - lo - 1
+                if width <= SWEEP_LANES:
+                    cands = list(range(lo + 1, hi))
+                else:
+                    step = (hi - lo) / (SWEEP_LANES + 1)
+                    cands = sorted({
+                        min(hi - 1, max(lo + 1, lo + int(round(step * (i + 1)))))
+                        for i in range(SWEEP_LANES)
+                    })
+                verdicts = sweep(cands, n_pad_refine, "refine")
+                if verdicts is None:  # unreachable after a live ladder call,
+                    fell_back = True  # but kept defensive
+                    break
+                goods = [c for c, g in zip(cands, verdicts) if g]
+                if goods:
+                    hi = min(goods)
+                bads = [c for c, g in zip(cands, verdicts)
+                        if not g and c < hi]
+                if bads:
+                    lo = max(bads)
+            if not fell_back:
+                best_result = finalize(hi, round_up(n_base + hi, 64))
+                metrics.CAPACITY_NODES_ADDED.set(hi)
+                return CapacityPlan(
+                    hi, best_result, attempts, retries, batched_calls
+                )
+            mode = "serial"
 
     # Exponential growth to bracket, seeded by the demand/supply estimate
     # (skips most low probes), then bisect over the FULL [0, hi] range —

@@ -35,6 +35,7 @@ from ..core.objects import (
     Pod,
 )
 from ..core.ordering import order_pods
+from ..core import workloads
 from ..core.workloads import WORKLOAD_KINDS, pods_from_workload
 from ..ops.encode import (
     Encoder,
@@ -46,7 +47,11 @@ from ..ops.encode import (
     initial_port_counts,
     initial_selector_counts,
 )
-from ..ops.fast import schedule_batch_fast
+from ..ops.fast import (
+    schedule_batch_fast,
+    schedule_scenarios_host,
+    scenario_bucket,
+)
 from ..ops.kernels import (
     FILTER_MESSAGES,
     NUM_FILTERS,
@@ -55,8 +60,10 @@ from ..ops.kernels import (
 )
 from ..ops.state import (
     align_carry,
+    align_carry_scenarios,
     carry_from_table,
     node_static_from_table,
+    stack_carry,
 )
 from ..utils import metrics
 from ..utils.tracing import progress, span
@@ -99,6 +106,65 @@ class AppResource:
     """One app: ordered list of decoded workload objects (core.go:47-51)."""
     name: str
     objects: List[dict] = field(default_factory=list)
+
+
+@dataclass
+class Scenario:
+    """One lane of a multi-scenario sweep (simulate_batch). Scenarios share
+    the cluster and app list; each lane varies the score weights and/or the
+    set of usable nodes. `node_count` keeps only the first N cluster nodes
+    (the capacity ladder's shape); `node_valid` is an explicit keep-mask over
+    cluster.nodes in order. At most one of the two may be set."""
+
+    name: str = ""
+    weights: Optional[dict] = None       # None = the sweep's default weights
+    node_count: Optional[int] = None
+    node_valid: Optional[Sequence[bool]] = None
+
+    def keep_mask(self, n_nodes: int) -> Optional[np.ndarray]:
+        """bool[n_nodes] keep-mask, or None when every node is usable."""
+        if self.node_count is not None and self.node_valid is not None:
+            raise ValueError(
+                "Scenario sets both node_count and node_valid"
+            )
+        if self.node_count is not None:
+            if not 0 <= self.node_count <= n_nodes:
+                raise ValueError(
+                    f"Scenario node_count {self.node_count} outside "
+                    f"[0, {n_nodes}]"
+                )
+            if self.node_count == n_nodes:
+                return None
+            mask = np.zeros(n_nodes, bool)
+            mask[: self.node_count] = True
+            return mask
+        if self.node_valid is not None:
+            mask = np.asarray(list(self.node_valid), bool)
+            if mask.shape != (n_nodes,):
+                raise ValueError(
+                    f"Scenario node_valid has {mask.shape[0]} entries for "
+                    f"{n_nodes} nodes"
+                )
+            return None if mask.all() else mask
+        return None
+
+
+@dataclass
+class ScenarioOutcome:
+    """Lightweight per-scenario verdict data from a non-materializing sweep
+    (run_scenarios(materialize=False)) — everything the capacity planner's
+    good() gate reads, without building S full SimulateResults."""
+
+    name: str
+    unscheduled: int
+    # totals mirroring satisfy_resource_setting's sums: allocatable over the
+    # scenario's nodes, requests over every bound pod (pre-bound + placed)
+    cpu_alloc: float = 0.0
+    cpu_req: float = 0.0
+    mem_alloc: float = 0.0
+    mem_req: float = 0.0
+    vg_cap: int = 0
+    vg_req: int = 0
 
 
 @dataclass
@@ -1120,53 +1186,59 @@ class Simulator:
     def _order(self, pods: List[Pod]) -> List[Pod]:
         return order_pods(pods, self.cluster.nodes, use_greed=self.use_greed)
 
-    # -- public ------------------------------------------------------------
-    def run(self, apps: Sequence[AppResource]) -> SimulateResult:
+    def _expand_apps(self, apps: Sequence[AppResource]) -> List[List[Pod]]:
+        """Expand every app's workloads into ordered pod lists (cache-aware;
+        shared by run() and run_scenarios())."""
         from ..core.validation import check_pods
 
-        with span("simulate", nodes=len(self.cluster.nodes), apps=len(apps)):
-            app_pods: List[List[Pod]] = []
-            with span("expand-workloads"):
-                for app in apps:
-                    pods: List[Pod] = []
-                    # keyed by POSITION in the app list, not name — the Simon
-                    # CR does not forbid duplicate app names, and the cache
-                    # contract already fixes the app list across reuses
-                    cache_key = len(app_pods)
-                    cached = (
-                        self._expand_cache.get(cache_key)
-                        if self._expand_cache is not None
-                        else None
+        app_pods: List[List[Pod]] = []
+        with span("expand-workloads"):
+            for app in apps:
+                pods: List[Pod] = []
+                # keyed by POSITION in the app list, not name — the Simon
+                # CR does not forbid duplicate app names, and the cache
+                # contract already fixes the app list across reuses
+                cache_key = len(app_pods)
+                cached = (
+                    self._expand_cache.get(cache_key)
+                    if self._expand_cache is not None
+                    else None
+                )
+                if self._expand_cache is not None:
+                    metrics.EXPAND_CACHE.inc(
+                        event="hit" if cached is not None else "miss"
                     )
-                    if self._expand_cache is not None:
-                        metrics.EXPAND_CACHE.inc(
-                            event="hit" if cached is not None else "miss"
+                fresh_entry: Dict[int, List[Pod]] = {}
+                fresh_validate: List[Pod] = []
+                for idx, obj in enumerate(app.objects):
+                    kind = obj.get("kind", "")
+                    if kind not in WORKLOAD_KINDS:
+                        continue
+                    if kind != "DaemonSet" and cached is not None:
+                        wl_pods = cached[idx]
+                        self._reset_bindings(wl_pods)
+                    else:
+                        wl_pods = pods_from_workload(
+                            obj, nodes=self.cluster.nodes
                         )
-                    fresh_entry: Dict[int, List[Pod]] = {}
-                    fresh_validate: List[Pod] = []
-                    for idx, obj in enumerate(app.objects):
-                        kind = obj.get("kind", "")
-                        if kind not in WORKLOAD_KINDS:
-                            continue
-                        if kind != "DaemonSet" and cached is not None:
-                            wl_pods = cached[idx]
-                            self._reset_bindings(wl_pods)
-                        else:
-                            wl_pods = pods_from_workload(
-                                obj, nodes=self.cluster.nodes
-                            )
-                            self._apply_patch_hooks(kind, wl_pods)
-                            fresh_validate.extend(wl_pods)
-                            if kind != "DaemonSet":
-                                fresh_entry[idx] = wl_pods
-                        pods.extend(wl_pods)
-                    # Cached pods were validated when first expanded; only
-                    # newly generated ones (first run, or DaemonSet pods,
-                    # whose synthesis is per-node) need checking.
-                    check_pods(fresh_validate, where=f"app {app.name}")
-                    if self._expand_cache is not None and cached is None:
-                        self._expand_cache[cache_key] = fresh_entry
-                    app_pods.append(self._order(pods))
+                        self._apply_patch_hooks(kind, wl_pods)
+                        fresh_validate.extend(wl_pods)
+                        if kind != "DaemonSet":
+                            fresh_entry[idx] = wl_pods
+                    pods.extend(wl_pods)
+                # Cached pods were validated when first expanded; only
+                # newly generated ones (first run, or DaemonSet pods,
+                # whose synthesis is per-node) need checking.
+                check_pods(fresh_validate, where=f"app {app.name}")
+                if self._expand_cache is not None and cached is None:
+                    self._expand_cache[cache_key] = fresh_entry
+                app_pods.append(self._order(pods))
+        return app_pods
+
+    # -- public ------------------------------------------------------------
+    def run(self, apps: Sequence[AppResource]) -> SimulateResult:
+        with span("simulate", nodes=len(self.cluster.nodes), apps=len(apps)):
+            app_pods = self._expand_apps(apps)
 
             with span("encode-cluster"):
                 self._build_device_state(
@@ -1204,16 +1276,268 @@ class Simulator:
                 result.preempted = list(self._preempted)
             return result
 
-    def _storage_status(self) -> Dict[str, NodeLocalStorage]:
+    def run_scenarios(
+        self,
+        apps: Sequence[AppResource],
+        scenarios: Sequence[Scenario],
+        materialize: bool = True,
+    ):
+        """One batched device sweep over S scenarios sharing this cluster and
+        app list: expand/encode once, stack the scan carry with a leading
+        scenario axis, and run the vmapped commit engine
+        (ops.fast.schedule_scenarios) — per-scenario placements are
+        bit-identical to S serial runs because invalid rows are inert in
+        every filter/score/commit (see ops/kernels.py) and the scan itself is
+        the naive engine every fast path proves equivalence against.
+
+        Returns a list of per-scenario SimulateResults (materialize=True) or
+        lightweight ScenarioOutcomes (materialize=False; the capacity
+        planner's verdict mode — no binding, no per-pod SCHEDULE_RESULT
+        metrics). Returns None when the workload needs per-scenario serial
+        control flow this path cannot batch: any pod with priority > 0
+        (preemption evicts different victims per lane) or a pre-bound pod on
+        a scenario-masked node. Callers (simulate_batch) fall back to serial
+        simulate() per scenario."""
+        scenarios = list(scenarios)
+        n_nodes = len(self.cluster.nodes)
+        keeps = [sc.keep_mask(n_nodes) for sc in scenarios]
+        with span(
+            "simulate-scenarios",
+            nodes=n_nodes, scenarios=len(scenarios), apps=len(apps),
+        ):
+            app_pods = self._expand_apps(apps)
+            all_pods = self._pending_cluster + [
+                p for pods in app_pods for p in pods
+            ]
+            if any(p.priority > 0 for p in all_pods):
+                return None
+            for keep in keeps:
+                if keep is None:
+                    continue
+                dropped = {
+                    n.name
+                    for n, k in zip(self.cluster.nodes, keep)
+                    if not k
+                }
+                if any(name in dropped for _, name in self._bound):
+                    return None
+            with span("encode-cluster"):
+                self._build_device_state(all_pods)
+            # Per-scenario valid masks over the shared padded node axis: pad
+            # rows stay False; masked real rows flip False per lane (inert in
+            # every kernel, so lanes see exactly their own node set).
+            table_valid = np.asarray(self._table.valid)
+            valid_rows = []
+            n_nodes_s = []
+            for keep in keeps:
+                v = table_valid.copy()
+                if keep is not None:
+                    v[:n_nodes] &= keep
+                    n_nodes_s.append(int(keep.sum()))
+                else:
+                    n_nodes_s.append(n_nodes)
+                valid_rows.append(v)
+            weight_rows = [
+                np.asarray(
+                    weights_array(sc.weights)
+                    if sc.weights is not None
+                    else self.weights
+                )
+                for sc in scenarios
+            ]
+            # Scenario-axis bucketing: pad to SCENARIO_BUCKET with copies of
+            # lane 0 (results discarded) so one compile serves nearby sweep
+            # sizes, mirroring the node-axis round_up(n, 64) in encode.
+            s_real = len(scenarios)
+            s_pad = scenario_bucket(s_real)
+            valid_rows += [valid_rows[0]] * (s_pad - s_real)
+            weight_rows += [weight_rows[0]] * (s_pad - s_real)
+            import jax.numpy as jnp
+
+            valid_s = jnp.asarray(np.stack(valid_rows))
+            weights_s = jnp.asarray(
+                np.stack(weight_rows).astype(np.float32)
+            )
+            carry_s = stack_carry(self._carry, s_pad)
+            lanes = [
+                {"placed": [], "failed": [], "fail_counts": None}
+                for _ in range(s_real)
+            ]
+            # Same batch structure as run(): cluster-pending first, then each
+            # app in configured order, split into consecutive schedulerName
+            # runs exactly like _schedule_batch_host.
+            batches = [self._order(self._pending_cluster)] + app_pods
+            for pods in batches:
+                i = 0
+                while i < len(pods):
+                    j = i
+                    name = pods[i].scheduler_name
+                    while j < len(pods) and pods[j].scheduler_name == name:
+                        j += 1
+                    run_pods = pods[i:j]
+                    i = j
+                    if name not in self._profiles:
+                        reason = (
+                            f"no scheduler profile named {name!r} is "
+                            "configured"
+                        )
+                        for lane in lanes:
+                            lane["failed"].extend(
+                                UnscheduledPod(p, reason) for p in run_pods
+                            )
+                        continue
+                    with span("encode", pods=len(run_pods)):
+                        batch = encode_pods(self.enc, run_pods)
+                    carry_s, self._ns = align_carry_scenarios(
+                        carry_s, self.enc, self._ns
+                    )
+                    with span(
+                        "schedule-scenarios",
+                        pods=len(run_pods), scenarios=s_real,
+                    ) as sp:
+                        (
+                            carry_s,
+                            nodes_np,
+                            reasons_np,
+                            take_np,
+                            vg_np,
+                            dev_np,
+                        ) = schedule_scenarios_host(
+                            self._ns, carry_s, batch,
+                            weights_s, valid_s, s_real,
+                        )
+                        sp.meta["scheduled"] = int((nodes_np >= 0).sum())
+                    progress(
+                        "scheduled scenario batch: %d/%d (pod,lane) placed "
+                        "in %.2fs",
+                        int((nodes_np >= 0).sum()),
+                        len(run_pods) * s_real,
+                        sp.duration,
+                    )
+                    metrics.SCHEDULING_ATTEMPTS.inc(len(run_pods) * s_real)
+                    for s, lane in enumerate(lanes):
+                        for p_idx, pod in enumerate(run_pods):
+                            ni = int(nodes_np[s, p_idx])
+                            if ni >= 0:
+                                lane["placed"].append((
+                                    pod, ni,
+                                    take_np[s, p_idx],
+                                    vg_np[s, p_idx],
+                                    dev_np[s, p_idx],
+                                ))
+                            else:
+                                if lane["fail_counts"] is None:
+                                    lane["fail_counts"] = np.zeros(
+                                        reasons_np.shape[2], np.int64
+                                    )
+                                lane["fail_counts"] += reasons_np[s, p_idx]
+                                lane["failed"].append(UnscheduledPod(
+                                    pod,
+                                    _reason_string(
+                                        n_nodes_s[s], reasons_np[s, p_idx]
+                                    ),
+                                ))
+            import jax
+
+            vg_free_s, dev_free_s = jax.device_get(
+                (carry_s.vg_free, carry_s.dev_free)
+            )
+            vg_free_s = np.asarray(vg_free_s)
+            dev_free_s = np.asarray(dev_free_s)
+            if not materialize:
+                return self._scenario_outcomes(
+                    scenarios, keeps, lanes, vg_free_s, dev_free_s
+                )
+            # Materialize lane by lane against the shared pod objects: bind,
+            # snapshot a deep copy, reset — each SimulateResult owns its pods
+            # so lanes cannot alias each other's mutations.
+            base_bound = list(self._bound)
+            results = []
+            with span("decode-scenarios"):
+                for s, lane in enumerate(lanes):
+                    keep = keeps[s]
+                    self._bound = list(base_bound)
+                    self._storage_takes = {}
+                    for pod, ni, take, vg, dev in lane["placed"]:
+                        self._bind_placed(pod, ni, take, vg, dev)
+                    if lane["fail_counts"] is not None:
+                        _count_filter_failures(lane["fail_counts"])
+                    self._finalize_unscheduled(lane["failed"])
+                    result = SimulateResult()
+                    result.unscheduled = list(lane["failed"])
+                    by_node: Dict[str, NodeStatus] = {
+                        n.name: NodeStatus(node=n)
+                        for idx, n in enumerate(self.cluster.nodes)
+                        if keep is None or keep[idx]
+                    }
+                    for pod, node_name in self._bound:
+                        if node_name in by_node:
+                            by_node[node_name].pods.append(pod)
+                    result.node_status = list(by_node.values())
+                    result.storage = self._storage_status(
+                        vg_free_s[s], dev_free_s[s], keep=keep
+                    )
+                    results.append(copy.deepcopy(result))
+                    self._reset_bindings([t[0] for t in lane["placed"]])
+            self._bound = base_bound
+            self._storage_takes = {}
+            return results
+
+    def _scenario_outcomes(
+        self, scenarios, keeps, lanes, vg_free_s, dev_free_s
+    ) -> List[ScenarioOutcome]:
+        """Aggregate each lane into the totals satisfy_resource_setting reads,
+        without materializing node_status (verdict mode)."""
+        outcomes = []
+        for s, lane in enumerate(lanes):
+            keep = keeps[s]
+            out = ScenarioOutcome(
+                name=scenarios[s].name or f"scenario-{s}",
+                unscheduled=len(lane["failed"]),
+            )
+            for idx, node in enumerate(self.cluster.nodes):
+                if keep is not None and not keep[idx]:
+                    continue
+                out.cpu_alloc += node.allocatable.get("cpu", 0)
+                out.mem_alloc += node.allocatable.get("memory", 0)
+            # requests over every bound pod: pre-bound (all on kept nodes —
+            # gated in run_scenarios) plus this lane's placements
+            for pod, _ in self._bound:
+                out.cpu_req += pod.requests.get("cpu", 0)
+                out.mem_req += pod.requests.get("memory", 0)
+            for pod, *_rest in lane["placed"]:
+                out.cpu_req += pod.requests.get("cpu", 0)
+                out.mem_req += pod.requests.get("memory", 0)
+            storage = self._storage_status(
+                vg_free_s[s], dev_free_s[s], keep=keep
+            )
+            for st in storage.values():
+                for vg in st.vgs:
+                    out.vg_cap += vg.capacity
+                    out.vg_req += vg.requested
+            outcomes.append(out)
+        return outcomes
+
+    def _storage_status(
+        self,
+        vg_free: Optional[np.ndarray] = None,
+        dev_free: Optional[np.ndarray] = None,
+        keep: Optional[np.ndarray] = None,
+    ) -> Dict[str, NodeLocalStorage]:
         """Decode the final vg_free/dev_free carry back into per-node storage
         state (parity: the bind-updated simon/node-local-storage annotations,
-        plugin/open-local.go:221-247)."""
+        plugin/open-local.go:221-247). A scenario fan-out passes its own
+        carry slices plus its node keep-mask; the default decodes the live
+        carry over every cluster node."""
         out: Dict[str, NodeLocalStorage] = {}
-        if self._carry is None:
-            return out
-        vg_free = np.asarray(self._carry.vg_free)
-        dev_free = np.asarray(self._carry.dev_free)
+        if vg_free is None or dev_free is None:
+            if self._carry is None:
+                return out
+            vg_free = np.asarray(self._carry.vg_free)
+            dev_free = np.asarray(self._carry.dev_free)
         for i, node in enumerate(self.cluster.nodes):
+            if keep is not None and not keep[i]:
+                continue
             st = node.local_storage()
             if st is None:
                 continue
@@ -1272,3 +1596,137 @@ def simulate(
         profiles=profiles, plugins=plugins, patch_pods=patch_pods,
         expand_cache=expand_cache, extenders=extenders,
     ).run(apps)
+
+
+def batch_ineligible_reason(
+    cluster: ClusterResource,
+    apps: Sequence[AppResource],
+    scenarios: Sequence[Scenario],
+    use_greed: bool = False,
+    mesh=None,
+    profiles=None,
+    plugins=None,
+    extenders=None,
+) -> Optional[str]:
+    """Why this sweep cannot take the batched (vmapped) path, or None when it
+    can. Every gate names a feature whose control flow is per-scenario serial
+    (host round-trips per pod, node-set-dependent expansion/ordering) —
+    simulate_batch falls back to serial simulate() per scenario for these."""
+    if mesh is not None:
+        return "mesh sharding"
+    if extenders:
+        return "scheduler extenders"
+    if profiles:
+        return "scheduler profiles"
+    if plugins:
+        return "out-of-tree device plugins"
+    masked = any(
+        sc.node_count is not None or sc.node_valid is not None
+        for sc in scenarios
+    )
+    if not masked:
+        return None
+    if use_greed:
+        # greed_sort keys on cluster_totals(nodes): per-scenario node sets
+        # would need per-scenario pod orderings
+        return "greed ordering with per-scenario node sets"
+    if cluster.daemonsets or any(
+        obj.get("kind") == "DaemonSet"
+        for app in apps
+        for obj in app.objects
+    ):
+        # DaemonSet expansion is per-node: lanes with different node sets
+        # would need different pod lists
+        return "DaemonSets with per-scenario node sets"
+    return None
+
+
+def _scenario_cluster(
+    cluster: ClusterResource, sc: Scenario
+) -> ClusterResource:
+    """The serial-fallback view of one scenario: the cluster restricted to the
+    lane's kept nodes (shares pod/daemonset/other objects — Simulator copies
+    what it mutates)."""
+    keep = sc.keep_mask(len(cluster.nodes))
+    if keep is None:
+        return cluster
+    return ClusterResource(
+        nodes=[n for n, k in zip(cluster.nodes, keep) if k],
+        pods=list(cluster.pods),
+        daemonsets=list(cluster.daemonsets),
+        others=dict(cluster.others),
+    )
+
+
+def simulate_batch(
+    cluster: ClusterResource,
+    apps: Sequence[AppResource],
+    scenarios: Sequence[Scenario],
+    *,
+    weights: Optional[dict] = None,
+    use_greed: bool = False,
+    mesh=None,
+    n_pad: Optional[int] = None,
+    profiles=None,
+    plugins=None,
+    patch_pods=None,
+    expand_cache=None,
+    extenders=None,
+) -> List[SimulateResult]:
+    """Simulate S scenarios against one cluster/app list, preferring a single
+    batched device sweep (Simulator.run_scenarios — the vmapped commit
+    engine) and falling back to per-scenario serial simulate() when a gated
+    feature forces it (see batch_ineligible_reason). Either way the return
+    is one ordinary SimulateResult per scenario, in scenario order, with
+    per-scenario placements identical between the two paths.
+
+    `weights` is the sweep default; Scenario.weights overrides per lane.
+    The serial fallback never shares `expand_cache` across lanes — results
+    must own their pods, and cached expansion would alias them."""
+    from ..utils.tracing import log
+
+    scenarios = list(scenarios)
+    if not scenarios:
+        return []
+    # Captured BEFORE the batched attempt: run_scenarios may expand the
+    # workloads (advancing the shared name RNG) and only then discover a
+    # post-expansion gate; the serial fallback below must still see the
+    # entry-time RNG state.
+    rng_state = workloads._rng.getstate()
+    reason = batch_ineligible_reason(
+        cluster, apps, scenarios, use_greed=use_greed, mesh=mesh,
+        profiles=profiles, plugins=plugins, extenders=extenders,
+    )
+    if reason is None:
+        results = Simulator(
+            cluster, weights=weights, use_greed=use_greed, n_pad=n_pad,
+            patch_pods=patch_pods, expand_cache=expand_cache,
+        ).run_scenarios(apps, scenarios)
+        if results is not None:
+            return results
+        reason = (
+            "preemption-eligible pods (priority > 0) or pre-bound pods on "
+            "scenario-masked nodes"
+        )
+    log.info(
+        "simulate_batch: serial fallback for %d scenario(s): %s",
+        len(scenarios), reason,
+    )
+    # Every lane must be byte-identical to a standalone simulate() of its
+    # scenario — including the random pod-name suffixes, which draw from the
+    # process-global seeded RNG. Rewind it to the entry state per lane so an
+    # earlier lane's expansion cannot perturb a later lane's names (the
+    # batched path gets this for free: all lanes share one expansion).
+    out = []
+    for sc in scenarios:
+        workloads._rng.setstate(rng_state)
+        out.append(
+            simulate(
+                _scenario_cluster(cluster, sc), apps,
+                weights=sc.weights if sc.weights is not None else weights,
+                use_greed=use_greed, mesh=mesh, n_pad=n_pad,
+                profiles=profiles, plugins=plugins, patch_pods=patch_pods,
+                expand_cache=None, extenders=extenders,
+            )
+        )
+    return out

@@ -44,7 +44,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .encode import PodBatch
+from .encode import PodBatch, round_up
 from .grouped import (
     DEFAULT_GROUP_CHUNK,
     _bucket,
@@ -75,6 +75,7 @@ from .kernels import (
     port_adds,
     ports_mask,
     resource_fail,
+    schedule_step,
 )
 from .sanitize import sanitizable
 from .state import pod_rows_from_batch
@@ -1745,3 +1746,109 @@ def schedule_batch_fast(
             commit(got, carry_dev)
 
     return carry, nodes_out, reasons_out, take_out, vg_out, dev_out
+
+
+# ---------------------------------------------------------------------------
+# Scenario axis: vmap the whole scan (ROADMAP item 1)
+# ---------------------------------------------------------------------------
+
+# Scenario-count bucket: the leading axis of every batched call is padded to a
+# multiple of this (pad scenarios are copies of scenario 0, results discarded)
+# so a sweep whose scenario count wobbles between calls still reuses one
+# compiled program per (node, pod) shape.
+SCENARIO_BUCKET = 8
+
+
+def scenario_bucket(s: int) -> int:
+    return round_up(max(int(s), 1), SCENARIO_BUCKET)
+
+
+# (N, P) shape key -> set of padded scenario counts seen: each distinct entry
+# in a value set is one compiled program for that bucket. The recompile guard
+# (analysis/jaxpr_audit.py) asserts every bucket stays at <= 2 programs across
+# a whole capacity sweep.
+_SCENARIO_PROGRAMS: dict = {}
+
+
+def scenario_programs() -> dict:
+    """Snapshot of {(n_nodes, n_pods): {padded scenario counts}} traced so far
+    through schedule_scenarios_host."""
+    return {k: set(v) for k, v in _SCENARIO_PROGRAMS.items()}
+
+
+def reset_scenario_programs() -> None:
+    _SCENARIO_PROGRAMS.clear()
+
+
+@sanitizable("ops.fast:schedule_scenarios")
+@jax.jit
+def schedule_scenarios(
+    ns: NodeStatic,
+    carry_s: Carry,
+    pods: PodRow,
+    weights_s: jnp.ndarray,
+    valid_s: jnp.ndarray,
+    filter_on=None,
+):
+    """The naive commit scan under jax.vmap over a leading scenario axis.
+
+    Scenarios share one padded node tensor (`ns`) and one pod sequence
+    (`pods`, broadcast); what varies per scenario is the node-valid mask
+    `valid_s` bool[S,N], the carry (every Carry leaf stacked on axis 0) and
+    the score-weight vector `weights_s` f32[S,W].
+
+    Exactness: every filter ANDs with ns.valid, reason counts gate on
+    ns.valid, score normalization masks by it, and _domain_counts
+    eligibility-masks its counts — so a row that is encoded-real but
+    valid=False for a scenario is fully inert, and lane s is bit-identical
+    to a serial schedule_batch over a table whose valid mask is valid_s[s].
+    Vmapping the NAIVE scan (not the host-driven fast paths) keeps the whole
+    sweep a single device dispatch; the fast paths prove bit-identity to
+    this same scan, so per-scenario results match serial simulate() output.
+
+    Returns (carry_s, nodes i32[S,P], reasons i32[S,P,F], gpu_take i32[S,P,G],
+    vg_take f32[S,P,V], dev_take f32[S,P,DV]).
+    """
+
+    def one(valid, carry, weights):
+        ns_s = ns._replace(valid=valid)
+
+        def step(c, pod):
+            return schedule_step(ns_s, weights, c, pod, filter_on)
+
+        final, (nodes, reasons, gpu_take, vg_take, dev_take) = jax.lax.scan(
+            step, carry, pods
+        )
+        return final, nodes, reasons, gpu_take, vg_take, dev_take
+
+    return jax.vmap(one)(valid_s, carry_s, weights_s)
+
+
+def schedule_scenarios_host(
+    ns: NodeStatic,
+    carry_s: Carry,
+    batch: PodBatch,
+    weights_s: jnp.ndarray,
+    valid_s: jnp.ndarray,
+    s_real: int,
+    filter_on=None,
+):
+    """Host driver for one batched call: dispatches schedule_scenarios and
+    returns (carry_s, nodes, reasons, gpu_take, vg_take, dev_take) with the
+    numpy outputs trimmed to the `s_real` live scenarios. `carry_s` /
+    `weights_s` / `valid_s` must already be padded to scenario_bucket(s_real)
+    (pad lanes = copies of scenario 0); the returned carry keeps the padded
+    axis so it threads straight into the next call."""
+    rows = pod_rows_from_batch(batch)
+    s_pad = int(valid_s.shape[0])
+    key = (int(ns.valid.shape[0]), int(batch.p))
+    _SCENARIO_PROGRAMS.setdefault(key, set()).add(s_pad)
+    _metrics.SCENARIOS_PER_CALL.observe(s_real)
+    _progress(
+        f"scenarios S={s_real}/{s_pad} P={batch.p} N={ns.valid.shape[0]}"
+    )
+    carry_s, nodes, reasons, gpu_take, vg_take, dev_take = schedule_scenarios(
+        ns, carry_s, rows, weights_s, valid_s, filter_on
+    )
+    got = jax.device_get((nodes, reasons, gpu_take, vg_take, dev_take))
+    return (carry_s,) + tuple(np.asarray(a)[:s_real] for a in got)

@@ -137,6 +137,57 @@ def _grow_rows(arr: jnp.ndarray, rows: int) -> jnp.ndarray:
     return jnp.zeros((rows, N), arr.dtype).at[:old].set(arr)
 
 
+def stack_carry(carry: Carry, count: int) -> Carry:
+    """Scenario-stacked Carry: every leaf gains a leading [S] axis holding
+    `count` identical copies — the starting state of a multi-scenario sweep
+    (all scenarios begin from the same cluster; their carries diverge as the
+    vmapped scan commits per-scenario placements)."""
+    import jax
+
+    return jax.tree.map(
+        lambda a: jnp.broadcast_to(a[None], (count,) + a.shape), carry
+    )
+
+
+def _grow_rows_stacked(arr: jnp.ndarray, rows: int) -> jnp.ndarray:
+    S, old, N = arr.shape
+    if rows <= old:
+        return arr
+    return jnp.zeros((S, rows, N), arr.dtype).at[:, :old].set(arr)
+
+
+def align_carry_scenarios(
+    carry_s: Carry, enc: Encoder, ns: Optional[NodeStatic] = None
+) -> Carry | Tuple[Carry, NodeStatic]:
+    """align_carry for a scenario-stacked carry ([S, rows, N] leaves): grows
+    the selector/port/anti row axes (axis 1) in lockstep across all scenarios.
+    Pass `ns` to also refresh NodeStatic.anti_topo, exactly as align_carry
+    does; returns (carry_s, ns) in that case."""
+    PID, PIP = port_table_sizes(enc)
+    new = {
+        "sel_counts": _grow_rows_stacked(
+            carry_s.sel_counts, selector_table_size(enc)
+        ),
+        "port_any": _grow_rows_stacked(carry_s.port_any, PID),
+        "port_wild": _grow_rows_stacked(carry_s.port_wild, PID),
+        "port_ipc": _grow_rows_stacked(carry_s.port_ipc, PIP),
+        "anti_counts": _grow_rows_stacked(
+            carry_s.anti_counts, anti_table_size(enc)
+        ),
+    }
+    if all(v is getattr(carry_s, k) for k, v in new.items()):
+        grown = carry_s
+    else:
+        grown = carry_s._replace(**new)
+    if ns is None:
+        return grown
+    want = anti_topo_array(enc)
+    have = np.asarray(ns.anti_topo)
+    if have.shape != want.shape or not np.array_equal(have, want):
+        ns = ns._replace(anti_topo=jnp.asarray(want))
+    return grown, ns
+
+
 def align_carry(
     carry: Carry, enc: Encoder, ns: Optional[NodeStatic] = None
 ) -> Carry | Tuple[Carry, NodeStatic]:

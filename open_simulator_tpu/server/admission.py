@@ -392,7 +392,11 @@ class AdmissionQueue:
         # 5. fan each group's one result back out to all of its waiters
         for k, res in zip(order, results):
             waiters = groups[k]
-            metrics.COALESCED_BATCH.observe(len(waiters))
+            # mode="fanout": N identical requests served by ONE result.
+            # (mode="scenarios" — distinct bodies merged into one batched
+            # device call — is observed by the executor, which is the layer
+            # that knows the scenario grouping; see server._execute_bodies.)
+            metrics.COALESCED_BATCH.observe(len(waiters), mode="fanout")
             for t in waiters:
                 if isinstance(res, BaseException):
                     self._finalize(t, 400, {"error": str(res)})

@@ -42,7 +42,13 @@ from ..core.objects import (
     ANNO_WORKLOAD_NAMESPACE,
     Node,
 )
-from ..engine.simulator import AppResource, ClusterResource, simulate
+from ..engine.simulator import (
+    AppResource,
+    ClusterResource,
+    Scenario,
+    simulate,
+    simulate_batch,
+)
 from ..utils import metrics
 from ..utils.concurrency import guarded_by
 from ..utils.yamlio import objects_from_directory
@@ -102,19 +108,60 @@ def _resolve_env_config() -> None:
                         globals()[attr])
 
 
+def _scenario_compat_key(body: dict) -> str:
+    """Digest of a request body MINUS its per-scenario `weights` field: two
+    bodies with equal compat keys describe the same cluster/apps and differ
+    only in score weights, so one batched (vmapped) device call can serve
+    both as scenario lanes."""
+    import hashlib
+
+    stripped = {k: v for k, v in body.items() if k != "weights"}
+    return hashlib.sha256(
+        json.dumps(stripped, sort_keys=True, separators=(",", ":")).encode()
+    ).hexdigest()
+
+
 def _execute_bodies(bodies: list) -> list:
-    """Admission-queue batch executor: one simulate pass per unique body,
-    per-body failures returned as the Exception (the queue fans it out as a
-    400 to that key's waiters only). Resolves _simulate_request through
-    module globals at call time so tests can monkeypatch it. This loop is
-    the seam the vmapped multi-scenario engine (ROADMAP item 1) replaces
-    with one batched device call."""
-    results: list = []
-    for body in bodies:
-        try:
-            results.append(_simulate_request(body))
-        except Exception as e:
-            results.append(e)
+    """Admission-queue batch executor. Bodies that differ only in their
+    `weights` field (same cluster/apps — see _scenario_compat_key) are
+    merged into ONE batched device call through the vmapped multi-scenario
+    engine (simulate_batch), observed as
+    osim_coalesced_batch_size{mode="scenarios"}; everything else runs one
+    simulate pass per body. Per-body failures are returned as the Exception
+    (the queue fans it out as a 400 to that key's waiters only) — a batched
+    group that fails re-runs serially so errors stay attributed per body.
+    Resolves _simulate_request/_simulate_scenario_group through module
+    globals at call time so tests can monkeypatch them."""
+    groups: dict = {}
+    order: list = []
+    for i, body in enumerate(bodies):
+        key = _scenario_compat_key(body)
+        if key not in groups:
+            groups[key] = []
+            order.append(key)
+        groups[key].append(i)
+    results: list = [None] * len(bodies)
+    for key in order:
+        idxs = groups[key]
+        if len(idxs) >= 2:
+            try:
+                outs = _simulate_scenario_group([bodies[i] for i in idxs])
+                for i, out in zip(idxs, outs):
+                    results[i] = out
+                continue
+            except Exception:
+                from ..utils.tracing import log
+
+                log.warning(
+                    "batched scenario group of %d failed; re-running "
+                    "serially for per-body error attribution", len(idxs),
+                    exc_info=True,
+                )
+        for i in idxs:
+            try:
+                results[i] = _simulate_request(bodies[i])
+            except Exception as e:
+                results[i] = e
     return results
 
 
@@ -235,7 +282,9 @@ def _refresh_snapshot_locked() -> ClusterResource:
     )
 
 
-def _simulate_request(body: dict) -> dict:
+def _request_cluster_apps(body: dict):
+    """Decode one request body into (cluster, apps) — shared by the serial
+    per-body path and the batched scenario-group path."""
     cluster_spec = body.get("cluster") or {}
     if "path" in cluster_spec:
         objs = objects_from_directory(cluster_spec["path"])
@@ -300,7 +349,10 @@ def _simulate_request(body: dict) -> dict:
         AppResource(name=a.get("name", f"app-{i}"), objects=list(a.get("objects") or []))
         for i, a in enumerate(body.get("apps") or [])
     ]
-    result = simulate(cluster, apps)
+    return cluster, apps
+
+
+def _format_result(result) -> dict:
     placements = {}
     for st in result.node_status:
         for pod in st.pods:
@@ -311,6 +363,28 @@ def _simulate_request(body: dict) -> dict:
             {"pod": u.pod.key, "reason": u.reason} for u in result.unscheduled
         ],
     }
+
+
+def _simulate_request(body: dict) -> dict:
+    cluster, apps = _request_cluster_apps(body)
+    result = simulate(cluster, apps, weights=body.get("weights"))
+    return _format_result(result)
+
+
+def _simulate_scenario_group(bodies: list) -> list:
+    """One batched device call for a group of scenario-compatible bodies
+    (identical cluster/apps, per-body weights): one vmapped lane per body,
+    results in body order. simulate_batch falls back to serial internally
+    when the workload is batch-ineligible, so this always returns real
+    per-body results."""
+    cluster, apps = _request_cluster_apps(bodies[0])
+    scenarios = [
+        Scenario(name=f"req-{i}", weights=b.get("weights"))
+        for i, b in enumerate(bodies)
+    ]
+    results = simulate_batch(cluster, apps, scenarios)
+    metrics.COALESCED_BATCH.observe(len(bodies), mode="scenarios")
+    return [_format_result(r) for r in results]
 
 
 def _cpu_profile(seconds: float) -> dict:

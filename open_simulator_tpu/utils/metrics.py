@@ -517,8 +517,23 @@ REQUESTS_DROPPED = REGISTRY.counter(
 )
 COALESCED_BATCH = REGISTRY.histogram(
     "osim_coalesced_batch_size",
-    "Requests answered by one coalesced simulate pass (per coalesce key).",
+    "Requests answered by one coalesced simulate pass: mode=fanout counts "
+    "identical-body waiters fanned out from one result (per coalesce key), "
+    "mode=scenarios counts distinct-scenario bodies merged into one batched "
+    "device call.",
+    labelnames=("mode",),
     buckets=(1, 2, 4, 8, 16, 32, 64),
+)
+SCENARIOS_PER_CALL = REGISTRY.histogram(
+    "osim_scenarios_per_call",
+    "Scenarios evaluated by one batched (vmapped) device call; the sample "
+    "count is the number of batched calls issued.",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+)
+BATCH_SWEEP_DURATION = REGISTRY.histogram(
+    "osim_batch_sweep_duration_seconds",
+    "Wall-clock duration of one batched multi-scenario sweep call "
+    "(capacity ladder/refinement or coalesced serving batch), seconds.",
 )
 REQUEST_LATENCY = REGISTRY.histogram(
     "osim_server_request_duration_seconds",

@@ -71,3 +71,70 @@ assert "run_end" in events[i:], f"resume never completed: {events}"
 print(f"crash-resume smoke OK: {pre} journaled trial(s) replayed, "
       f"{post} re-run, outcome byte-identical")
 EOF
+
+# ---------------------------------------------------------------------------
+# Batched leg (docs/batching.md): same contract for the batched capacity
+# sweep, whose journal unit is a `sweep` record carrying ALL lane verdicts
+# of one vmapped device call. SIGKILL between sweep commits, resume, and
+# require zero re-run scenarios for the surviving records plus a
+# byte-identical outcome.json. (example/ configs carry DaemonSets, which
+# force the serial fallback — the DS-free tests/fixtures/sweep config is
+# the batch-eligible one.)
+# ---------------------------------------------------------------------------
+SWEEP_CFG=tests/fixtures/sweep/simon-config.yaml
+
+# 6. Reference: one uninterrupted journaled batched sweep.
+python -m open_simulator_tpu.cli.main sweep -f "$SWEEP_CFG" --capacity \
+    --run-dir "$SCRATCH/sweepref" > /dev/null
+[ -f "$SCRATCH/sweepref/outcome.json" ] || { echo "no sweep reference outcome"; exit 1; }
+
+# 7. Crash run: SIGKILL the moment the 2nd `sweep` record would commit —
+#    the first batched call is journaled, the rest never happened.
+cat > "$SCRATCH/sweep-faults.yaml" <<'EOF'
+rules:
+  - target: journal
+    op: sweep
+    kind: kill
+    after: 1
+EOF
+rc=0
+OSIM_FAULT_PLAN="$SCRATCH/sweep-faults.yaml" \
+    python -m open_simulator_tpu.cli.main sweep -f "$SWEEP_CFG" --capacity \
+    --run-dir "$SCRATCH/sweepcrash" > /dev/null 2>&1 || rc=$?
+if [ "$rc" -ne 137 ] && [ "$rc" -ne 1 ]; then
+    echo "expected the sweep to be SIGKILLed (rc 137), got rc=$rc"; exit 1
+fi
+[ -f "$SCRATCH/sweepcrash/outcome.json" ] && { echo "crashed sweep wrote an outcome?"; exit 1; }
+
+# 8. Resume through the same entry point as apply runs (`runs resume`
+#    dispatches on the journaled kind).
+python -m open_simulator_tpu.cli.main runs resume "$SCRATCH/sweepcrash" > /dev/null
+
+# 9. Byte-identity again — attempts, batched_calls, and placement digest
+#    all live in the timestamp-free snapshot.
+cmp "$SCRATCH/sweepref/outcome.json" "$SCRATCH/sweepcrash/outcome.json" || {
+    echo "resumed sweep outcome differs from the uninterrupted run:"
+    diff "$SCRATCH/sweepref/outcome.json" "$SCRATCH/sweepcrash/outcome.json" || true
+    exit 1
+}
+
+# 10. The surviving sweep record replayed (zero re-run scenarios for it);
+#     only the killed-and-after batched calls ran live after run_resume.
+python - "$SCRATCH/sweepcrash" "$SCRATCH/sweepref" <<'EOF'
+import sys
+from open_simulator_tpu.durable import replay
+events = [e["event"] for e in replay(sys.argv[1])]
+ref_sweeps = [e["event"] for e in replay(sys.argv[2])].count("sweep")
+i = events.index("run_resume")
+pre = events[:i].count("sweep")
+post = events[i:].count("sweep")
+assert pre >= 1, f"no sweep record survived the crash: {events}"
+assert pre + post == ref_sweeps, (
+    f"sweep count drifted: {pre} journaled + {post} re-run != "
+    f"{ref_sweeps} in the reference run: {events}"
+)
+assert "final" in events[i:], f"resume never materialized the plan: {events}"
+assert "run_end" in events[i:], f"resume never completed: {events}"
+print(f"crash-resume smoke OK (batched): {pre} sweep record(s) replayed "
+      f"with zero re-run scenarios, {post} re-run, outcome byte-identical")
+EOF

@@ -212,7 +212,7 @@ def test_watchdog_budget_unguarded_when_a_waiter_has_no_deadline(monkeypatch):
 def test_coalesced_batch_fans_out_per_request_results():
     execute, calls = _recorder()
     q = AdmissionQueue(execute, depth=8, coalesce_ms=0.0, clock=ManualClock())
-    _, sum0, count0 = metrics.COALESCED_BATCH.child_state()
+    _, sum0, count0 = metrics.COALESCED_BATCH.child_state(mode="fanout")
     body = {"apps": [{"name": "web"}]}
     t1 = q.submit(body, key="same")
     t2 = q.submit(dict(body), key="same")
@@ -223,7 +223,7 @@ def test_coalesced_batch_fans_out_per_request_results():
     assert t1.code == t2.code == t3.code == 200
     assert t1.payload == t2.payload == {"echo": body}
     assert t3.payload == {"echo": {"apps": []}}
-    _, sum1, count1 = metrics.COALESCED_BATCH.child_state()
+    _, sum1, count1 = metrics.COALESCED_BATCH.child_state(mode="fanout")
     assert count1 - count0 == 2  # two coalesce groups observed
     assert sum1 - sum0 == 3      # sizes 2 + 1
 

@@ -379,6 +379,232 @@ def test_capacity_resume_reruns_only_missing_trials(
 
 
 # ---------------------------------------------------------------------------
+# Batched capacity sweep: journaled `sweep` records + resume.
+# ---------------------------------------------------------------------------
+
+HOSTNAME_ANTI = {
+    "podAntiAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": [
+            {
+                "labelSelector": {"matchLabels": {"app": "lonely"}},
+                "topologyKey": "kubernetes.io/hostname",
+            }
+        ]
+    }
+}
+
+
+@pytest.fixture(scope="module")
+def batch_overloaded():
+    """Batch-eligible fixture (no DaemonSets/priority/greed — the yaml
+    fixtures all carry a DaemonSet, which forces the serial fallback) whose
+    hostname anti-affinity defeats the demand/supply estimate, so the
+    batched search issues several `sweep` records worth resuming."""
+    from open_simulator_tpu.engine.simulator import (
+        AppResource,
+        ClusterResource,
+    )
+    from tests.factories import make_deployment, make_node
+
+    cluster = ClusterResource(
+        nodes=[make_node(f"base-{i}", cpu="32", memory="64Gi")
+               for i in range(2)]
+    )
+    apps = [
+        AppResource(
+            name="app",
+            objects=[
+                make_deployment(
+                    "lonely", replicas=24, cpu="500m", memory="1Gi",
+                    with_affinity=HOSTNAME_ANTI,
+                )
+            ],
+        )
+    ]
+    return cluster, apps, make_node("clone", cpu="32", memory="64Gi")
+
+
+def _plan_counting_batched(monkeypatch, cluster, apps, new_node, journal,
+                           resume):
+    """plan_capacity(sweep_mode=auto) with both live-work channels counted:
+    `simulate` (serial probes + the final materialize) and
+    `Simulator.run_scenarios` (batched device calls)."""
+    from open_simulator_tpu.engine import capacity
+
+    real_simulate = capacity.simulate
+    real_sim_cls = capacity.Simulator
+    serial_calls = []
+    batched_live = []
+
+    def counting(*a, **kw):
+        serial_calls.append(1)
+        return real_simulate(*a, **kw)
+
+    class CountingSimulator(real_sim_cls):
+        def run_scenarios(self, *a, **kw):
+            batched_live.append(1)
+            return super().run_scenarios(*a, **kw)
+
+    monkeypatch.setattr(capacity, "simulate", counting)
+    monkeypatch.setattr(capacity, "Simulator", CountingSimulator)
+    plan = capacity.plan_capacity(
+        cluster, apps, new_node, journal=journal, resume=resume
+    )
+    monkeypatch.setattr(capacity, "simulate", real_simulate)
+    monkeypatch.setattr(capacity, "Simulator", real_sim_cls)
+    return plan, len(serial_calls), len(batched_live)
+
+
+def _seed_journal_with_events(src_dir, dst_dir, events):
+    """Simulate a crash: the dst run dir gets exactly `events` from the src
+    run's journal (the crash happened before anything else committed)."""
+    os.makedirs(dst_dir, exist_ok=True)
+    with open(os.path.join(dst_dir, JOURNAL_NAME), "w") as fh:
+        for e in events:
+            fh.write(json.dumps(e, sort_keys=True) + "\n")
+
+
+def test_batched_sweep_journals_all_lane_verdicts(
+    tmp_path, monkeypatch, batch_overloaded
+):
+    from open_simulator_tpu.core.workloads import reset_name_rng
+
+    cluster, apps, new_node = batch_overloaded
+    d = str(tmp_path / "fresh")
+    reset_name_rng()
+    j = RunJournal.open(d)
+    plan, serial_calls, batched_live = _plan_counting_batched(
+        monkeypatch, cluster, apps, new_node, j, resume=False
+    )
+    j.close()
+    assert plan is not None and plan.nodes_added >= 1
+    sweeps = [e for e in replay(d) if e["event"] == "sweep"]
+    # one committed `sweep` record per live batched device call, each
+    # carrying ALL lane verdicts for that call
+    assert len(sweeps) == plan.batched_calls == batched_live >= 2
+    for e in sweeps:
+        assert e["phase"] in ("ladder", "refine")
+        assert len(e["counts"]) == len(e["good"]) >= 1
+        assert e["n_pad"] >= len(cluster.nodes)
+    # attempts = the base trial + every lane verdict of every sweep
+    assert plan.attempts == 1 + sum(len(e["counts"]) for e in sweeps)
+    assert serial_calls == 2  # base trial + final materialize
+
+
+def test_batched_sweep_resume_reruns_zero_scenarios(
+    tmp_path, monkeypatch, batch_overloaded
+):
+    from open_simulator_tpu.core.workloads import reset_name_rng
+    from open_simulator_tpu.engine.apply import placement_digest
+
+    cluster, apps, new_node = batch_overloaded
+    d1 = str(tmp_path / "fresh")
+    reset_name_rng()
+    j1 = RunJournal.open(d1)
+    fresh_plan, _, fresh_batched = _plan_counting_batched(
+        monkeypatch, cluster, apps, new_node, j1, resume=False
+    )
+    j1.close()
+    assert fresh_plan is not None and fresh_batched >= 2
+
+    # crash after the base trial + ALL sweep records committed (before the
+    # final landed): the resume replays every verdict from the journal —
+    # ZERO live scenarios — and only re-runs the materializing final
+    d2 = str(tmp_path / "resumed")
+    _seed_journal_with_events(
+        d1, d2,
+        [e for e in replay(d1) if e["event"] in ("trial", "sweep")],
+    )
+    j2 = RunJournal.open(d2)
+    resumed_plan, resumed_serial, resumed_batched = _plan_counting_batched(
+        monkeypatch, cluster, apps, new_node, j2, resume=True
+    )
+    j2.close()
+    assert resumed_batched == 0  # zero re-run scenarios
+    assert resumed_serial == 1  # only the final materialize
+    assert resumed_plan.nodes_added == fresh_plan.nodes_added
+    assert resumed_plan.attempts == fresh_plan.attempts
+    assert resumed_plan.batched_calls == fresh_plan.batched_calls
+    assert resumed_plan.retries == fresh_plan.retries
+    assert placement_digest(resumed_plan.result) == placement_digest(
+        fresh_plan.result
+    )
+    ev2 = replay(d2)
+    assert len([e for e in ev2 if e["event"] == "sweep"]) == fresh_batched
+    assert [e["event"] for e in ev2][-1] == "final"
+
+
+def test_batched_sweep_resume_reruns_only_missing_sweeps(
+    tmp_path, monkeypatch, batch_overloaded
+):
+    from open_simulator_tpu.core.workloads import reset_name_rng
+
+    cluster, apps, new_node = batch_overloaded
+    d1 = str(tmp_path / "fresh")
+    reset_name_rng()
+    j1 = RunJournal.open(d1)
+    fresh_plan, _, fresh_batched = _plan_counting_batched(
+        monkeypatch, cluster, apps, new_node, j1, resume=False
+    )
+    j1.close()
+
+    # crash one sweep earlier: exactly that device call re-runs live
+    events = [e for e in replay(d1) if e["event"] in ("trial", "sweep")]
+    sweep_idx = [i for i, e in enumerate(events) if e["event"] == "sweep"]
+    d2 = str(tmp_path / "resumed")
+    _seed_journal_with_events(d1, d2, events[: sweep_idx[-1]])
+    j2 = RunJournal.open(d2)
+    resumed_plan, resumed_serial, resumed_batched = _plan_counting_batched(
+        monkeypatch, cluster, apps, new_node, j2, resume=True
+    )
+    j2.close()
+    assert resumed_batched == 1
+    assert resumed_serial == 1
+    assert resumed_plan.nodes_added == fresh_plan.nodes_added
+    assert resumed_plan.attempts == fresh_plan.attempts
+    assert resumed_plan.batched_calls == fresh_plan.batched_calls
+
+
+def test_sweep_cli_resume_outcome_byte_identical(tmp_path, monkeypatch):
+    """`simon sweep --capacity` end-to-end: a crashed-then-resumed run's
+    outcome.json is byte-identical to an uninterrupted one (the in-process
+    twin of scripts/crash_resume_smoke.sh's batched leg)."""
+    from open_simulator_tpu.cli.main import main as cli_main
+    from open_simulator_tpu.core.workloads import reset_name_rng
+
+    cfg = os.path.join(FIXTURES, "sweep", "simon-config.yaml")
+    ref = str(tmp_path / "ref")
+    reset_name_rng()
+    rc = cli_main([
+        "sweep", "-f", cfg, "--capacity", "--run-dir", ref,
+    ])
+    assert rc == 0
+    ref_bytes = open(os.path.join(ref, "outcome.json"), "rb").read()
+    doc = json.loads(ref_bytes)
+    assert doc["kind"] == "sweep" and doc["batched_calls"] >= 1
+    assert doc["placement_digest"]
+
+    # "crash" before the final/run_end committed, then resume via the CLI
+    crash = str(tmp_path / "crash")
+    _seed_journal_with_events(
+        ref, crash,
+        [e for e in replay(ref)
+         if e["event"] in ("run_start", "trial", "sweep")],
+    )
+    assert not os.path.exists(os.path.join(crash, "outcome.json"))
+    reset_name_rng()
+    rc = cli_main([
+        "sweep", "-f", cfg, "--capacity", "--run-dir", crash, "--resume",
+    ])
+    assert rc == 0
+    crash_bytes = open(os.path.join(crash, "outcome.json"), "rb").read()
+    assert crash_bytes == ref_bytes
+    ev = replay(crash)
+    assert "run_resume" in [e["event"] for e in ev]
+    assert [e["event"] for e in ev][-1] == "run_end"
+
+
+# ---------------------------------------------------------------------------
 # run_apply end-to-end: journaled outcome, resume identity, provenance.
 # ---------------------------------------------------------------------------
 
