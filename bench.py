@@ -897,6 +897,110 @@ def config_serving_concurrent(
         srv.server_close()
 
 
+def config_resident_delta_10k(n_nodes=10_000, n_deltas=30, touched=8):
+    """Config 10: the resident-state delta path (engine/resident.py) at 10k
+    nodes. A ResidentCluster cold-encodes once, then absorbs `n_deltas`
+    refreshes that each bind `touched` new pods; the per-sync delta wall
+    (host row re-encode + jitted scatters) is compared against the full
+    `encode_nodes` re-encode the non-resident path would pay per refresh.
+    The acceptance bar is speedup_x >= 10; the run ends with one forced
+    drift-detector pass, so a digest divergence (or any repair during the
+    walk) is reported as an error, not a faster-but-wrong number."""
+    import statistics
+    import tempfile
+    import time
+
+    from open_simulator_tpu.core.objects import Pod
+    from open_simulator_tpu.engine.resident import ResidentCluster
+    from open_simulator_tpu.ops.encode import encode_nodes
+
+    nodes = [_mk_node(f"r-{i}", "32", "64Gi") for i in range(n_nodes)]
+
+    def bound_pod(serial: int, node_name: str) -> Pod:
+        return Pod.from_dict(
+            {
+                "metadata": {"name": f"b-{serial}", "namespace": "bench"},
+                "spec": {
+                    "nodeName": node_name,
+                    "containers": [
+                        {
+                            "name": "c",
+                            "image": "img",
+                            "resources": {
+                                "requests": {"cpu": "500m", "memory": "1Gi"}
+                            },
+                        }
+                    ],
+                },
+            }
+        )
+
+    pods = [bound_pod(i, f"r-{i % n_nodes}") for i in range(256)]
+    prev = os.environ.get("OSIM_RESIDENT_VERIFY_EVERY")
+    os.environ["OSIM_RESIDENT_VERIFY_EVERY"] = "0"  # time pure applies
+    try:
+        res = ResidentCluster(journal_dir=tempfile.mkdtemp(prefix="osim-bench-"))
+        t0 = time.time()
+        res.sync(nodes, pods)  # cold start: full encode + device upload
+        cold_wall = time.time() - t0
+
+        full_walls = []
+        for _ in range(3):
+            t0 = time.time()
+            encode_nodes(
+                res.enc, nodes,
+                existing_usage=res._usage, existing_gpu=res._gpu_usage,
+                n_pad=res._host.n, min_axes=res._axes,
+            )
+            full_walls.append(time.time() - t0)
+
+        serial = len(pods)
+        delta_walls = []
+        for k in range(n_deltas):
+            for j in range(touched):
+                serial += 1
+                pods.append(bound_pod(serial, f"r-{(serial * 131) % n_nodes}"))
+            t0 = time.time()
+            res.sync(nodes, pods)
+            delta_walls.append(time.time() - t0)
+        delta_walls = delta_walls[1:]  # first sync pays the scatter-jit trace
+
+        full_ms = 1000 * statistics.median(full_walls)
+        delta_ms = 1000 * statistics.median(delta_walls)
+        verified = res.verify_now()
+    finally:
+        if prev is None:
+            os.environ.pop("OSIM_RESIDENT_VERIFY_EVERY", None)
+        else:
+            os.environ["OSIM_RESIDENT_VERIFY_EVERY"] = prev
+
+    speedup = full_ms / delta_ms if delta_ms > 0 else None
+    out = {
+        "wall_s": round(sum(full_walls) + sum(delta_walls) + cold_wall, 2),
+        "value": round(speedup, 1) if speedup else None,
+        "unit": "x faster than full re-encode",
+        "nodes": n_nodes,
+        "deltas": n_deltas,
+        "touched_rows_per_delta": touched,
+        "cold_encode_ms": round(1000 * cold_wall, 1),
+        "full_encode_ms": round(full_ms, 1),
+        "delta_apply_ms": round(delta_ms, 2),
+        "speedup_x": round(speedup, 1) if speedup else None,
+        "verified": bool(verified),
+        "repairs": res.repairs,
+    }
+    if not verified or res.repairs:
+        out["error"] = (
+            f"drift during bench: verified={verified} repairs={res.repairs}"
+        )
+    elif speedup is not None and speedup < 10:
+        out["error"] = (
+            f"delta apply only {speedup:.1f}x faster than full re-encode "
+            "(acceptance floor is 10x)"
+        )
+    return out
+
+
 CONFIGS = {
     "stock": config_stock,
     "fit_1k_100n": config_fit,
@@ -909,6 +1013,7 @@ CONFIGS = {
     "preempt_tiered": config_preempt,
     "extender_1k": config_extender,
     "serving_concurrent": config_serving_concurrent,
+    "resident_delta_10k": config_resident_delta_10k,
 }
 
 
@@ -1024,6 +1129,7 @@ SEGMENT_TIMEOUT_S = {
     "preempt_tiered": 900.0,
     "extender_1k": 900.0,
     "serving_concurrent": 600.0,
+    "resident_delta_10k": 900.0,
 }
 
 

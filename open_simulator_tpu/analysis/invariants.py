@@ -775,6 +775,15 @@ def _r_is_finite(interp, eqn, ins):
     return [_bool_out()]
 
 
+def _r_bitcast(interp, eqn, ins):
+    """Reinterpreting bits severs every numeric relationship between input
+    and output (an f32 in [0,1] bitcast to u32 spans almost the whole u32
+    range), so the only sound transfer is TOP of the OUTPUT kind. That stays
+    precise where it matters: a bitcast to an integer kind cannot introduce
+    inf/NaN, which is exactly what ops.delta's digest_fold relies on."""
+    return [top(kind_of(eqn.params["new_dtype"]))]
+
+
 _RULES: Dict[str, Callable] = {
     "add": _binary(_r_add),
     "sub": _binary(_r_sub),
@@ -811,6 +820,7 @@ _RULES: Dict[str, Callable] = {
     "sort": _r_sort,
     "iota": _r_iota,
     "convert_element_type": _r_convert,
+    "bitcast_convert_type": _r_bitcast,
     "broadcast_in_dim": _identity,
     "reshape": _identity,
     "transpose": _identity,

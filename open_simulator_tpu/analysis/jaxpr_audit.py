@@ -70,6 +70,7 @@ AUDIT_TARGETS: Dict[str, Tuple[str, ...]] = {
     ),
     "open_simulator_tpu.ops.grouped": ("_group_jit",),
     "open_simulator_tpu.ops.kernels": ("schedule_batch", "probe_step", "commit_step"),
+    "open_simulator_tpu.ops.delta": ("apply_rows", "apply_flags", "digest_fold"),
 }
 
 #: entries the canonical state MUST exercise — a refactor that silently
@@ -89,6 +90,9 @@ REQUIRED_COVERAGE = frozenset(
         "ops.kernels:schedule_batch",
         "ops.kernels:probe_step",
         "ops.kernels:commit_step",
+        "ops.delta:apply_rows",
+        "ops.delta:apply_flags",
+        "ops.delta:digest_fold",
     }
 )
 
@@ -359,6 +363,18 @@ def _capture_calls() -> List[_Captured]:
             ns, state_mod.stack_carry(carry, s_pad), batch,
             weights_s, valid_s, 2,
         )
+        # the resident-state delta kernels (engine/resident.py): scatter two
+        # rows into the canonical free plane at production shapes (bucketed
+        # index vector, pad slots dropped), flag-set on the valid vector,
+        # and one drift-detector digest per representative dtype
+        delta = importlib.import_module("open_simulator_tpu.ops.delta")
+        n = int(carry.free.shape[0])
+        idx = jnp.asarray(delta.pad_indices([0, 1], n))
+        rows = jnp.zeros((int(idx.shape[0]),) + carry.free.shape[1:],
+                         carry.free.dtype)
+        delta.apply_rows(carry.free, idx, rows)
+        delta.apply_flags(ns.valid, idx, jnp.zeros(int(idx.shape[0]), bool))
+        delta.digest_fold(carry.free)
         del np
     finally:
         for module, attr, original in patches:

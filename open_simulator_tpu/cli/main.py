@@ -540,6 +540,11 @@ def _run_chaos(args) -> int:
     # failure mode admission control exists to prevent.
     shed = total(metrics.REQUESTS_SHED)
     dropped = total(metrics.REQUESTS_DROPPED)
+    # Resident-state self-healing (engine/resident.py): a repair is the
+    # anti-entropy loop WORKING — the drifted/torn state was re-encoded from
+    # the source of truth before answering, so it counts as degradation,
+    # never as failure.
+    repairs = total(metrics.RESIDENT_DRIFT_REPAIRS)
     failed_apps = sorted(fa.name for fa in outcome.failed_apps)
     not_closed = sorted(
         ep for ep, state in breaker_states().items() if state != "closed"
@@ -547,6 +552,7 @@ def _run_chaos(args) -> int:
     unscheduled = outcome.result.unscheduled
     degraded = bool(
         retries or skips or stale or failed_apps or not_closed or shed
+        or repairs
     )
 
     lines.append("degraded:")
@@ -558,6 +564,7 @@ def _run_chaos(args) -> int:
     lines.append(f"  ignorable extenders skipped: {skips}")
     lines.append(f"  stale snapshots served: {stale}")
     lines.append(f"  requests shed with Retry-After: {shed}")
+    lines.append(f"  resident drift repairs: {repairs}")
     lines.append(
         "  circuit breakers not closed: "
         + (", ".join(not_closed) if not_closed else "none")

@@ -259,6 +259,7 @@ class Simulator:
         patch_pods=None,
         expand_cache=None,
         extenders=None,
+        resident=None,
     ) -> None:
         """`mesh` (jax.sharding.Mesh or None): when set, the node axis of the
         cluster state is sharded across the mesh devices and the same grouped
@@ -266,6 +267,15 @@ class Simulator:
         local shards, argmax/min-max/domain reductions as ICI collectives
         (the production analog of the reference's 16-goroutine node fan-out,
         parallelize/parallelism.go:26-57).
+
+        `resident` (engine/resident.ResidentCluster or None): opt-in fast
+        path for the serving loop — when the resident state covers this
+        exact cluster + bound-pod set, _build_device_state adopts its
+        encoder and device planes instead of a full encode_nodes pass.
+        Gated off under mesh/extenders/n_pad (those change the encoding);
+        any non-covering condition falls back to the full encode, counted
+        in osim_resident_fallbacks_total. Never a correctness downgrade:
+        coverage is checked by content, not by trust.
 
         `expand_cache` (dict or None): capacity-search optimization — a dict
         shared across repeated simulations of the SAME apps against varying
@@ -301,6 +311,14 @@ class Simulator:
         # every pod list generated from that workload kind.
         self._patch_pods = dict(patch_pods or {})
         self._expand_cache = expand_cache
+        self._resident = (
+            resident
+            if resident is not None
+            and mesh is None
+            and not self._extenders
+            and n_pad is None
+            else None
+        )
         non_ds_hooks = [k for k in self._patch_pods if k != "DaemonSet"]
         if expand_cache is not None and non_ds_hooks:
             # see the docstring: cached expansion would apply these hooks
@@ -397,7 +415,29 @@ class Simulator:
     def _build_device_state(self, all_pods: Sequence[Pod]) -> None:
         """Register every pod that will ever be scheduled, then ship the node
         table once. Registering everything up front keeps the resource axis
-        and selector ids stable across app batches."""
+        and selector ids stable across app batches.
+
+        With a covering ResidentCluster the node table and NodeStatic come
+        from the resident device planes (no encode_nodes pass, no node-plane
+        transfer); the per-request selector/port/anti counts are still built
+        here — they depend on this request's registered selectors."""
+        res = self._resident
+        if res is not None:
+            reason = res.covers_reason(self.cluster.nodes, self._bound)
+            if reason is None:
+                self.enc = res.enc
+                self._table, self._ns = res.device_state(
+                    list(all_pods), self._bound
+                )
+                sel = initial_selector_counts(self.enc, self._table, self._bound)
+                ports = initial_port_counts(self.enc, self._table, self._bound)
+                anti = initial_anti_counts(self.enc, self._table, self._bound)
+                self._carry = carry_from_table(
+                    self._table, sel, port_counts=ports, anti_counts=anti
+                )
+                self._reshard()
+                return
+            metrics.RESIDENT_FALLBACKS.inc(reason=reason)
         self.enc.register_pods(list(all_pods))
         for pod, _ in self._bound:
             self.enc.register_pods([pod])
@@ -1581,6 +1621,7 @@ def simulate(
     patch_pods=None,
     expand_cache=None,
     extenders=None,
+    resident=None,
 ) -> SimulateResult:
     """One-shot simulation (parity: simulator.Simulate, core.go:67-119).
 
@@ -1590,11 +1631,13 @@ def simulate(
     `expand_cache`: see Simulator — share one dict across re-simulations of
     the same apps (capacity search) to expand/validate workloads once.
     `extenders`: ExtenderConfig list (models/profiles.py) — HTTP
-    filter/prioritize callbacks (WithExtenders parity)."""
+    filter/prioritize callbacks (WithExtenders parity).
+    `resident`: optional engine/resident.ResidentCluster serving fast path
+    (see Simulator)."""
     return Simulator(
         cluster, weights=weights, use_greed=use_greed, mesh=mesh, n_pad=n_pad,
         profiles=profiles, plugins=plugins, patch_pods=patch_pods,
-        expand_cache=expand_cache, extenders=extenders,
+        expand_cache=expand_cache, extenders=extenders, resident=resident,
     ).run(apps)
 
 
@@ -1672,6 +1715,7 @@ def simulate_batch(
     patch_pods=None,
     expand_cache=None,
     extenders=None,
+    resident=None,
 ) -> List[SimulateResult]:
     """Simulate S scenarios against one cluster/app list, preferring a single
     batched device sweep (Simulator.run_scenarios — the vmapped commit
@@ -1701,6 +1745,7 @@ def simulate_batch(
         results = Simulator(
             cluster, weights=weights, use_greed=use_greed, n_pad=n_pad,
             patch_pods=patch_pods, expand_cache=expand_cache,
+            resident=resident,
         ).run_scenarios(apps, scenarios)
         if results is not None:
             return results
@@ -1726,7 +1771,7 @@ def simulate_batch(
                 weights=sc.weights if sc.weights is not None else weights,
                 use_greed=use_greed, mesh=mesh, n_pad=n_pad,
                 profiles=profiles, plugins=plugins, patch_pods=patch_pods,
-                expand_cache=None, extenders=extenders,
+                expand_cache=None, extenders=extenders, resident=resident,
             )
         )
     return out

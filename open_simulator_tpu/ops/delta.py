@@ -1,0 +1,113 @@
+"""Delta kernels for the resident cluster state (engine/resident.py).
+
+Two tiny jit families keep the device copy of the node planes in sync without
+a full ops/encode re-encode:
+
+  * apply_rows / apply_flags — scatter freshly re-encoded rows into the
+    resident planes. Row *contents* are always recomputed on the host by the
+    exact encode_node_into code path (never incrementally adjusted on device:
+    f32 accumulation is non-associative, and byte-identity with a fresh encode
+    is the resident path's correctness contract), so the device work is pure
+    data movement. Index vectors are bucket-padded; pad slots carry an
+    out-of-range index and are dropped by XLA's scatter `mode="drop"`.
+
+  * digest_fold — an order-independent-combining u32 digest of one tensor,
+    used by the drift detector. Float planes are bitcast to their raw u32
+    pattern (NaN payloads and signed zeros included — the digest must see
+    exactly the bytes a fresh encode would produce), ints/bools are widened.
+    Each element is weighted by an odd constant (2i+1) so permutations and
+    zero-fills still change the sum, then summed mod 2^32 (uint32 wraparound).
+    digest_fold_host is the numpy twin that produces bit-identical values for
+    host-side arrays; combine_digests chains per-plane digests (FNV-1a style)
+    into one cluster digest.
+
+All jit entries here are registered with analysis/jaxpr_audit.py and the
+invariant prover — they run inside the serving loop, so they get the same
+static guarantees as the scheduling kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .encode import round_up
+from .sanitize import sanitizable
+
+__all__ = [
+    "apply_rows",
+    "apply_flags",
+    "digest_fold",
+    "digest_fold_host",
+    "combine_digests",
+    "pad_indices",
+]
+
+
+def pad_indices(idx: Iterable[int], n: int) -> np.ndarray:
+    """Bucket-pad a host index list to i32[round_up(U, 8)]; pad slots hold n
+    (one past the last row), which scatter `mode="drop"` discards. Bucketing
+    keeps the jit cache warm across delta batches of similar size."""
+    raw = np.asarray(list(idx), np.int32)
+    u = round_up(max(len(raw), 1), 8)
+    out = np.full(u, n, np.int32)
+    out[: len(raw)] = raw
+    return out
+
+
+@sanitizable("ops.delta:apply_rows")
+@jax.jit
+def apply_rows(arr: jnp.ndarray, idx: jnp.ndarray, rows: jnp.ndarray) -> jnp.ndarray:
+    """Scatter whole re-encoded rows into a 2-D plane: arr[idx[u]] = rows[u].
+    Out-of-range idx entries (the pad slots) are dropped, not clamped —
+    clamping would silently overwrite the last real row."""
+    return arr.at[idx].set(rows, mode="drop")
+
+
+@sanitizable("ops.delta:apply_flags")
+@jax.jit
+def apply_flags(arr: jnp.ndarray, idx: jnp.ndarray, vals: jnp.ndarray) -> jnp.ndarray:
+    """apply_rows for 1-D per-node vectors (unsched/valid flags, name ids)."""
+    return arr.at[idx].set(vals, mode="drop")
+
+
+def _bits_u32(x: jnp.ndarray) -> jnp.ndarray:
+    if x.dtype == jnp.float32:
+        return jax.lax.bitcast_convert_type(x, jnp.uint32)
+    return x.astype(jnp.uint32)
+
+
+@sanitizable("ops.delta:digest_fold")
+@jax.jit
+def digest_fold(x: jnp.ndarray) -> jnp.ndarray:
+    """u32[] position-weighted checksum of one tensor (see module docstring).
+    Returns a scalar uint32; the only host transfer the drift detector pays is
+    this 4-byte scalar per plane."""
+    u = _bits_u32(x).ravel()
+    w = jnp.arange(u.shape[0], dtype=jnp.uint32) * jnp.uint32(2) + jnp.uint32(1)
+    return jnp.sum(u * w, dtype=jnp.uint32)
+
+
+def digest_fold_host(x: np.ndarray) -> int:
+    """Bit-identical numpy twin of digest_fold for host-resident arrays."""
+    x = np.ascontiguousarray(x)
+    if x.dtype == np.float32:
+        u = x.view(np.uint32).ravel()
+    else:
+        u = x.astype(np.uint32).ravel()
+    # All-uint32 arithmetic: numpy array multiply and sum both wrap mod 2^32,
+    # matching the device's uint32 wraparound bit for bit.
+    w = np.arange(u.size, dtype=np.uint32) * np.uint32(2) + np.uint32(1)
+    return int(np.sum(u * w, dtype=np.uint32))
+
+
+def combine_digests(parts: Iterable[int]) -> int:
+    """Chain per-plane digests into one cluster digest (FNV-1a over u32
+    words). Order matters — callers fold planes in a fixed field order."""
+    h = 2166136261
+    for p in parts:
+        h = ((h ^ (int(p) & 0xFFFFFFFF)) * 16777619) & 0xFFFFFFFF
+    return h

@@ -32,6 +32,7 @@ from ..core.objects import (
     RESOURCE_GPU_COUNT,
     LabelSelector,
     Node,
+    NodeLocalStorage,
     Pod,
 )
 
@@ -401,16 +402,140 @@ def _num_or_nan(s: str) -> float:
         return float("nan")
 
 
+def node_axes(
+    enc: Encoder,
+    nodes: Sequence[Node],
+    storages: Optional[Sequence[Optional["NodeLocalStorage"]]] = None,
+) -> Tuple[int, int, int, int, int]:
+    """Bucketed per-node axis caps (L, T, G, V, DV) for this node list — the
+    shape-defining maxima of encode_nodes, factored out so the resident delta
+    path can detect when an incoming node no longer fits the resident buckets
+    (and must trigger a structural re-encode instead of a row scatter)."""
+    if storages is None:
+        storages = [nd.local_storage() for nd in nodes]
+    L = round_up(max((len(nd.meta.labels) for nd in nodes), default=1), 4)
+    T = round_up(max((len(nd.taints) for nd in nodes), default=1), 2)
+    G = round_up(max((nd.gpu_count() for nd in nodes), default=1), 2)
+    V = round_up(max((len(s.vgs) for s in storages if s), default=1), 2)
+    DV = round_up(max((len(s.devices) for s in storages if s), default=1), 2)
+    return L, T, G, V, DV
+
+
+# Sentinel distinguishing "caller already decoded local storage (maybe None)"
+# from "not provided — decode it here"; None is a legal storage value.
+_STORAGE_UNSET: Optional[NodeLocalStorage] = NodeLocalStorage()
+
+
+def clear_node_row(table: NodeTable, i: int) -> None:
+    """Reset row i of every per-node array to the pad value encode_nodes
+    allocates (zeros, NaN label_num, -1 topo, False flags) so a subsequent
+    encode_node_into writes bytes identical to a from-scratch encode."""
+    table.alloc[i] = 0.0
+    table.free[i] = 0.0
+    table.label_pair[i] = 0
+    table.label_key[i] = 0
+    table.label_num[i] = np.nan
+    table.taint_key[i] = 0
+    table.taint_val[i] = 0
+    table.taint_effect[i] = 0
+    table.name_id[i] = 0
+    table.unsched[i] = False
+    table.avoid_pods[i] = False
+    table.topo[i] = -1
+    table.valid[i] = False
+    table.gpu_total[i] = 0.0
+    table.gpu_free[i] = 0.0
+    table.vg_cap[i] = 0.0
+    table.vg_free[i] = 0.0
+    table.vg_name[i] = 0
+    table.dev_cap[i] = 0.0
+    table.dev_ssd[i] = False
+    table.dev_free[i] = 0.0
+    table.has_storage[i] = False
+
+
+def encode_node_into(
+    enc: Encoder,
+    table: NodeTable,
+    i: int,
+    nd: Node,
+    usage: Dict[str, Dict[str, int]],
+    gpu_usage: Dict[str, np.ndarray],
+    st: Optional["NodeLocalStorage"] = _STORAGE_UNSET,
+) -> None:
+    """Encode one node into row i of a zeroed/cleared table. This is THE
+    per-node encode — encode_nodes loops over it and the resident delta path
+    replays it for changed rows, so both produce identical bytes by
+    construction. Assumes row i holds pad values (see clear_node_row)."""
+    L = table.label_pair.shape[1]
+    T = table.taint_key.shape[1]
+    V = table.vg_cap.shape[1]
+    DV = table.dev_cap.shape[1]
+    table.valid[i] = True
+    table.name_id[i] = enc.names.id(nd.name)
+    table.unsched[i] = nd.unschedulable
+    table.avoid_pods[i] = (
+        "scheduler.alpha.kubernetes.io/preferAvoidPods" in nd.meta.annotations
+    )
+    for r, res in enumerate(enc.resources):
+        a = nd.allocatable.get(res, 0) / resource_scale(res)
+        table.alloc[i, r] = a
+        used = usage.get(nd.name, {}).get(res, 0) / resource_scale(res)
+        table.free[i, r] = a - used
+    for j, (k, v) in enumerate(sorted(nd.meta.labels.items())):
+        if j >= L:
+            break
+        table.label_key[i, j] = enc.keys.id(k)
+        table.label_pair[i, j] = enc.pair_id(k, v)
+        table.label_num[i, j] = _num_or_nan(v)
+    for j, t in enumerate(nd.taints):
+        if j >= T:
+            break
+        table.taint_key[i, j] = enc.keys.id(t.key)
+        table.taint_val[i, j] = enc.vals.id(t.value)
+        table.taint_effect[i, j] = _EFFECTS.get(t.effect, 0)
+    table.topo[i, 0] = i  # hostname: every node is its own domain
+    for k_idx, key in enumerate(enc.topology_keys[1:], start=1):
+        v = nd.meta.labels.get(key)
+        if v is not None:
+            table.topo[i, k_idx] = enc.domain_id(k_idx, key, v)
+    g_cnt = nd.gpu_count()
+    if g_cnt > 0:
+        per_dev = np.float32(nd.gpu_mem_per_device() / float(1 << 20))
+        table.gpu_total[i, :g_cnt] = per_dev
+        table.gpu_free[i, :g_cnt] = per_dev
+        used = gpu_usage.get(nd.name)
+        if used is not None:
+            table.gpu_free[i, : len(used)] -= used.astype(np.float32)
+    if st is _STORAGE_UNSET:
+        st = nd.local_storage()
+    if st is not None:
+        table.has_storage[i] = True
+        for j, vg in enumerate(st.vgs[:V]):
+            table.vg_name[i, j] = enc.vgs.id(vg.name)
+            table.vg_cap[i, j] = np.float32(vg.capacity / float(1 << 20))
+            table.vg_free[i, j] = np.float32(
+                max(vg.capacity - vg.requested, 0) / float(1 << 20)
+            )
+        for j, dev in enumerate(st.devices[:DV]):
+            table.dev_cap[i, j] = np.float32(dev.capacity / float(1 << 20))
+            table.dev_ssd[i, j] = dev.media_type == "ssd"
+            table.dev_free[i, j] = 0.0 if dev.is_allocated else 1.0
+
+
 def encode_nodes(
     enc: Encoder,
     nodes: Sequence[Node],
     existing_usage: Optional[Dict[str, Dict[str, int]]] = None,
     existing_gpu: Optional[Dict[str, np.ndarray]] = None,
     n_pad: Optional[int] = None,
+    min_axes: Optional[Tuple[int, int, int, int, int]] = None,
 ) -> NodeTable:
     """Build the node table. existing_usage maps node name -> canonical request
     totals of already-bound pods (subtracted into `free`); existing_gpu maps
-    node name -> used MiB per device (from aggregate_gpu_usage)."""
+    node name -> used MiB per device (from aggregate_gpu_usage). min_axes is an
+    optional (L, T, G, V, DV) floor — the resident path pins it to its resident
+    bucket sizes so a verification re-encode lands in identical shapes."""
     n = len(nodes)
     # Node-axis floor of 64: tiny clusters pay a few inert padded rows, and
     # in exchange the whole jit family (scan/traj/light/sort) keeps ONE shape
@@ -418,13 +543,15 @@ def encode_nodes(
     # big scheduling graphs dominates small-cluster wall time otherwise.
     N = n_pad if n_pad is not None else round_up(n, 64)
     R = len(enc.resources)
-    L = round_up(max((len(nd.meta.labels) for nd in nodes), default=1), 4)
-    T = round_up(max((len(nd.taints) for nd in nodes), default=1), 2)
     K = max(len(enc.topology_keys), 1)
-    G = round_up(max((nd.gpu_count() for nd in nodes), default=1), 2)
     storages = [nd.local_storage() for nd in nodes]
-    V = round_up(max((len(s.vgs) for s in storages if s), default=1), 2)
-    DV = round_up(max((len(s.devices) for s in storages if s), default=1), 2)
+    L, T, G, V, DV = node_axes(enc, nodes, storages)
+    if min_axes is not None:
+        L = max(L, min_axes[0])
+        T = max(T, min_axes[1])
+        G = max(G, min_axes[2])
+        V = max(V, min_axes[3])
+        DV = max(DV, min_axes[4])
 
     alloc = np.zeros((N, R), np.float32)
     free = np.zeros((N, R), np.float32)
@@ -451,56 +578,7 @@ def encode_nodes(
 
     usage = existing_usage or {}
     gpu_usage = existing_gpu or {}
-    for i, nd in enumerate(nodes):
-        valid[i] = True
-        name_id[i] = enc.names.id(nd.name)
-        unsched[i] = nd.unschedulable
-        avoid[i] = "scheduler.alpha.kubernetes.io/preferAvoidPods" in nd.meta.annotations
-        for r, res in enumerate(enc.resources):
-            a = nd.allocatable.get(res, 0) / resource_scale(res)
-            alloc[i, r] = a
-            used = usage.get(nd.name, {}).get(res, 0) / resource_scale(res)
-            free[i, r] = a - used
-        for j, (k, v) in enumerate(sorted(nd.meta.labels.items())):
-            if j >= L:
-                break
-            label_key[i, j] = enc.keys.id(k)
-            label_pair[i, j] = enc.pair_id(k, v)
-            label_num[i, j] = _num_or_nan(v)
-        for j, t in enumerate(nd.taints):
-            if j >= T:
-                break
-            taint_key[i, j] = enc.keys.id(t.key)
-            taint_val[i, j] = enc.vals.id(t.value)
-            taint_effect[i, j] = _EFFECTS.get(t.effect, 0)
-        topo[i, 0] = i  # hostname: every node is its own domain
-        for k_idx, key in enumerate(enc.topology_keys[1:], start=1):
-            v = nd.meta.labels.get(key)
-            if v is not None:
-                topo[i, k_idx] = enc.domain_id(k_idx, key, v)
-        g_cnt = nd.gpu_count()
-        if g_cnt > 0:
-            per_dev = np.float32(nd.gpu_mem_per_device() / float(1 << 20))
-            gpu_total[i, :g_cnt] = per_dev
-            gpu_free[i, :g_cnt] = per_dev
-            used = gpu_usage.get(nd.name)
-            if used is not None:
-                gpu_free[i, : len(used)] -= used.astype(np.float32)
-        st = storages[i]
-        if st is not None:
-            has_storage[i] = True
-            for j, vg in enumerate(st.vgs[:V]):
-                vg_name[i, j] = enc.vgs.id(vg.name)
-                vg_cap[i, j] = np.float32(vg.capacity / float(1 << 20))
-                vg_free[i, j] = np.float32(
-                    max(vg.capacity - vg.requested, 0) / float(1 << 20)
-                )
-            for j, dev in enumerate(st.devices[:DV]):
-                dev_cap[i, j] = np.float32(dev.capacity / float(1 << 20))
-                dev_ssd[i, j] = dev.media_type == "ssd"
-                dev_free[i, j] = 0.0 if dev.is_allocated else 1.0
-
-    return NodeTable(
+    table = NodeTable(
         alloc=alloc, free=free, label_pair=label_pair, label_key=label_key,
         label_num=label_num, taint_key=taint_key, taint_val=taint_val,
         taint_effect=taint_effect, name_id=name_id, unsched=unsched,
@@ -511,6 +589,9 @@ def encode_nodes(
         has_storage=has_storage,
         names=[nd.name for nd in nodes],
     )
+    for i, nd in enumerate(nodes):
+        encode_node_into(enc, table, i, nd, usage, gpu_usage, st=storages[i])
+    return table
 
 
 def _encode_term_exprs(enc: Encoder, exprs, EXPR: int, VAL: int):

@@ -16,15 +16,19 @@ Plan schema:
     rules:
       - target: extender          # extender | kubeclient | chart
                                   # | backend | journal | admission
+                                  # | resident
         op: filter                # optional substring match on the call's
                                   # operation (extender verb, api path,
                                   # chart release/path, backend stage,
                                   # journal event, admission phase
-                                  # "submit"/"drain"); empty = any
+                                  # "submit"/"drain", resident phase
+                                  # "apply"/"verify"/"fence"); empty = any
         kind: connection_error    # latency | connection_error | http_error
                                   # | malformed_json | error | kill
                                   # | queue_full | slow_drain
                                   # | deadline_storm  (admission only)
+                                  # | torn_delta | stale_generation
+                                  # | digest_mismatch  (resident only)
         times: 2                  # inject on the first 2 matching calls
                                   # (omit = every matching call)
         after: 0                  # skip this many matching calls first
@@ -51,10 +55,14 @@ import yaml
 
 from ..utils import metrics
 
-TARGETS = ("extender", "kubeclient", "chart", "backend", "journal", "admission")
+TARGETS = (
+    "extender", "kubeclient", "chart", "backend", "journal", "admission",
+    "resident",
+)
 KINDS = (
     "latency", "connection_error", "http_error", "malformed_json", "error",
     "kill", "queue_full", "slow_drain", "deadline_storm",
+    "torn_delta", "stale_generation", "digest_mismatch",
 )
 
 

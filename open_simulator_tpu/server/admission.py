@@ -106,17 +106,27 @@ def _env_int(name: str, default: int) -> int:
     return int(_env_float(name, float(default)))
 
 
-def coalesce_key(path: str, body: dict, generation: Optional[int] = None) -> str:
+def coalesce_key(
+    path: str,
+    body: dict,
+    generation: Optional[int] = None,
+    stale: bool = False,
+) -> str:
     """Stable identity of a request's *work*: two requests with the same key
     would produce byte-identical results, so one simulate pass serves both.
     `generation` folds in the live-snapshot generation for kubeconfig-backed
-    requests (the same body against a refreshed snapshot is different work)."""
+    requests (the same body against a refreshed snapshot is different work).
+    `stale` marks a snapshot served past a failed refresh: the failure does
+    not advance the generation, so staleness needs its own key dimension —
+    a request admitted while degraded must never share a response with one
+    admitted against the same generation served fresh."""
     digest = hashlib.sha256(
         json.dumps(body, sort_keys=True, separators=(",", ":")).encode()
     ).hexdigest()
     if generation is None:
         return f"{path}:{digest}"
-    return f"{path}:{digest}:gen{generation}"
+    suffix = ":stale" if stale else ""
+    return f"{path}:{digest}:gen{generation}{suffix}"
 
 
 @dataclass
@@ -128,6 +138,9 @@ class Ticket:
     key: str
     enqueued_at: float
     deadline_at: Optional[float] = None  # absolute, clock() domain
+    # live-snapshot generation recorded at admission; None = not fenced.
+    # _run_batch re-keys the ticket if the queue's fence moved past it.
+    fence_epoch: Optional[int] = None
     done: threading.Event = field(default_factory=threading.Event)
     # response (valid once done is set)
     code: int = 0
@@ -170,8 +183,13 @@ class AdmissionQueue:
         clock: Callable[[], float] = time.monotonic,
         service_time_s: float = DEFAULT_SERVICE_TIME_S,
         watchdog_poll_s: float = 0.25,
+        fence: Optional[Callable[[], int]] = None,
     ) -> None:
         self._execute = execute
+        # Generation fence (engine/resident.py): called once per batch at
+        # dequeue; fenced tickets whose recorded epoch differs are re-keyed
+        # so they can only coalesce with same-state work (docs/serving.md).
+        self._fence = fence
         self.depth = (
             depth
             if depth is not None
@@ -235,8 +253,11 @@ class AdmissionQueue:
         key: Optional[str] = None,
         deadline_ms: Optional[float] = None,
         op: str = "submit",
+        fence_epoch: Optional[int] = None,
     ) -> Ticket:
-        """Admit, or immediately shed, one request. Never blocks."""
+        """Admit, or immediately shed, one request. Never blocks.
+        `fence_epoch` is the live-snapshot generation the caller keyed the
+        request under (None = the request is not generation-dependent)."""
         now = self._clock()
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
@@ -245,6 +266,7 @@ class AdmissionQueue:
             key=key if key is not None else coalesce_key("", body),
             enqueued_at=now,
             deadline_at=(now + deadline_ms / 1000.0) if deadline_ms > 0 else None,
+            fence_epoch=fence_epoch,
         )
         rule = faults.maybe_inject("admission", op)
         with self._cv:
@@ -341,11 +363,29 @@ class AdmissionQueue:
                 live.append(t)
         if not live:
             return
-        # 2. injected slow drain (models a wedged backend eating the window)
+        # 2. generation fence AT DEQUEUE: a fenced ticket admitted under
+        #    epoch E whose snapshot moved to E' before this batch drained is
+        #    re-keyed onto E' — it will be served against the E' state, and
+        #    must only coalesce with other E' work. Without this, a ticket
+        #    keyed "...:genE" could fan out one result to waiters that were
+        #    admitted across a state change (the stale_generation chaos kind
+        #    forces the mismatch by returning a sentinel epoch).
+        if self._fence is not None and any(t.fence_epoch is not None for t in live):
+            current = self._fence()
+            for t in live:
+                if t.fence_epoch is None:
+                    continue
+                if t.fence_epoch == current:
+                    metrics.ADMISSION_FENCE.inc(outcome="current")
+                else:
+                    t.key += f"@fence{current}"
+                    t.fence_epoch = current
+                    metrics.ADMISSION_FENCE.inc(outcome="rekeyed")
+        # 3. injected slow drain (models a wedged backend eating the window)
         rule = faults.maybe_inject("admission", "drain")
         if rule is not None and rule.kind == "slow_drain" and rule.latency_s > 0:
             time.sleep(rule.latency_s)
-        # 3. coalesce: one executor entry per distinct key, arrival order
+        # 4. coalesce: one executor entry per distinct key, arrival order
         groups: Dict[str, List[Ticket]] = {}
         order: List[str] = []
         for t in live:
@@ -354,7 +394,7 @@ class AdmissionQueue:
                 order.append(t.key)
             groups[t.key].append(t)
         bodies = [groups[k][0].body for k in order]
-        # 4. watchdog budget: the most generous live deadline (a stricter
+        # 5. watchdog budget: the most generous live deadline (a stricter
         #    per-request budget would abort shared work other waiters still
         #    have time for); deadline-less waiters fall back to the global
         #    OSIM_CALL_DEADLINE_S (0 = unguarded).
@@ -389,7 +429,7 @@ class AdmissionQueue:
             self._service_time_s = max(
                 0.3 * per_entry + 0.7 * self._service_time_s, 0.001
             )
-        # 5. fan each group's one result back out to all of its waiters
+        # 6. fan each group's one result back out to all of its waiters
         for k, res in zip(order, results):
             waiters = groups[k]
             # mode="fanout": N identical requests served by ONE result.

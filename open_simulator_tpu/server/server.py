@@ -72,7 +72,15 @@ _resync_s = RESYNC_SECONDS
 _snapshot_lock = threading.Lock()
 _snapshot: Optional[ClusterResource] = None
 _snapshot_at = 0.0
-_snapshot_fetches = 0  # observability + test hook; doubles as the generation
+_snapshot_fetches = 0  # observability + test hook (NOT the coalesce generation
+#                        — that is the resident epoch / (fetches, stale) pair
+#                        below, see _snapshot_generation)
+_snapshot_stale = False  # last refresh attempt failed; serving cached data
+# Device-resident encoded planes for the live snapshot (engine/resident.py):
+# created on the first successful fetch when OSIM_RESIDENT is on, delta-synced
+# on every refresh, handed to simulate() so live-snapshot requests skip the
+# full re-encode. None when no live source or the knob is off.
+_resident = None  # Optional[engine.resident.ResidentCluster]
 
 # Per-connection socket read timeout: a slow-loris client trickling a request
 # body would otherwise pin a handler thread forever. Body reads that exceed
@@ -203,6 +211,11 @@ class _DrainingHTTPServer(ThreadingHTTPServer):
             depth=queue_depth,
             coalesce_ms=coalesce_ms,
             default_deadline_ms=default_deadline_ms,
+            # generation fence: tickets stamped with a live-snapshot epoch at
+            # submit are re-keyed at dequeue if the epoch moved (resident
+            # delta / snapshot refresh) — a coalesced batch can never mix
+            # requests that saw different cluster states
+            fence=_fence_epoch,
         ).start()
 
     def server_close(self) -> None:
@@ -211,23 +224,44 @@ class _DrainingHTTPServer(ThreadingHTTPServer):
         self.admission.join(timeout=5.0)
 
 
-def _snapshot_generation() -> int:
-    """Identity of the cached live snapshot, folded into coalesce keys so
-    identical bodies against different snapshots are never merged."""
+def _snapshot_generation() -> tuple:
+    """Identity of the cached live snapshot as (generation, stale), folded
+    into coalesce keys so identical bodies against different snapshots are
+    never merged. The generation is the resident epoch when a resident
+    exists — globally monotonic, never reused across re-serves — with
+    _snapshot_fetches as the fallback when OSIM_RESIDENT=0. `stale` marks a
+    snapshot being served past a failed refresh (_refresh_snapshot_locked's
+    degradation path): the refresh failure does NOT advance the generation,
+    so without the flag a body admitted just before the apiserver flapped
+    would coalesce with one admitted just after — same data, but the stale
+    response carries degraded-mode semantics the fresh one must not inherit."""
     with _snapshot_lock:
-        return _snapshot_fetches
+        gen = _resident.fence_epoch() if _resident is not None else _snapshot_fetches
+        return gen, _snapshot_stale
 
 
-def _coalesce_key_for(path: str, body: dict) -> str:
+def _fence_epoch() -> int:
+    """Dequeue-side fence value for the admission queue (see
+    AdmissionQueue fence=): the current generation only, staleness has its
+    own key dimension."""
+    return _snapshot_generation()[0]
+
+
+def _coalesce_key_for(path: str, body: dict) -> tuple:
+    """(coalesce key, fence epoch) for one request. Only live-snapshot bodies
+    are generation-keyed and fenced (fence_epoch=None for the rest: a body
+    that carries its own cluster produces the same bytes under any epoch, so
+    re-keying it at dequeue would only split a valid coalesce)."""
     spec = body.get("cluster") or {}
     uses_live = (
         "path" not in spec
         and not spec.get("objects")
         and bool(_kubeconfig or _master)
     )
-    return admission_mod.coalesce_key(
-        path, body, generation=_snapshot_generation() if uses_live else None
-    )
+    if not uses_live:
+        return admission_mod.coalesce_key(path, body), None
+    gen, stale = _snapshot_generation()
+    return admission_mod.coalesce_key(path, body, generation=gen, stale=stale), gen
 
 
 def _live_snapshot() -> ClusterResource:
@@ -244,9 +278,11 @@ def _live_snapshot() -> ClusterResource:
 def _refresh_snapshot_locked() -> ClusterResource:
     import time
 
-    global _snapshot, _snapshot_at, _snapshot_fetches
+    global _snapshot, _snapshot_at, _snapshot_fetches, _snapshot_stale
+    global _resident
     now = time.monotonic()
     if _snapshot is None or now - _snapshot_at > _resync_s:
+        from ..engine.resident import ResidentCluster, resident_enabled
         from ..utils.kubeclient import (
             KubeClientError,
             create_cluster_resource_from_kubeconfig,
@@ -258,6 +294,16 @@ def _refresh_snapshot_locked() -> ClusterResource:
             )
             _snapshot_at = now
             _snapshot_fetches += 1
+            _snapshot_stale = False
+            # Keep the device-resident planes in lockstep with the cache:
+            # most refreshes land as row deltas, structural changes or drift
+            # degrade to a full re-encode inside sync() (engine/resident.py).
+            # sync() with the knob off keeps the state machine honest about a
+            # mid-run OSIM_RESIDENT=0 flip (counted as a "disabled" repair).
+            if _resident is None and resident_enabled():
+                _resident = ResidentCluster()
+            if _resident is not None:
+                _resident.sync(_snapshot.nodes, _snapshot.pods)
         except KubeClientError as e:
             if _snapshot is None:
                 raise  # nothing cached to degrade to
@@ -265,9 +311,12 @@ def _refresh_snapshot_locked() -> ClusterResource:
             # snapshot instead of failing the request (the reference's
             # informer cache behaves the same way when the apiserver flaps).
             # _snapshot_at is left unchanged so the next request retries the
-            # refresh immediately.
+            # refresh immediately; _snapshot_stale stamps the staleness into
+            # coalesce keys (_snapshot_generation) so degraded responses
+            # never merge with fresh ones.
             from ..utils.tracing import log
 
+            _snapshot_stale = True
             metrics.SNAPSHOT_STALE.inc()
             log.warning(
                 "cluster snapshot refresh failed (%s); serving stale "
@@ -365,9 +414,31 @@ def _format_result(result) -> dict:
     }
 
 
+def _request_resident(body: dict):
+    """The ResidentCluster to offer simulate(), or None. Only live-snapshot
+    bodies can be covered, and a body that edits the cluster (newNodes /
+    removeWorkloads) is simulated against a derived cluster the resident does
+    not hold — skipping it here avoids a guaranteed not_covering fallback.
+    simulate() still re-checks coverage (covers_reason), so offering the
+    resident is always safe, never load-bearing."""
+    spec = body.get("cluster") or {}
+    uses_live = (
+        "path" not in spec
+        and not spec.get("objects")
+        and bool(_kubeconfig or _master)
+    )
+    if not uses_live or body.get("newNodes") or body.get("removeWorkloads"):
+        return None
+    with _snapshot_lock:
+        return _resident
+
+
 def _simulate_request(body: dict) -> dict:
     cluster, apps = _request_cluster_apps(body)
-    result = simulate(cluster, apps, weights=body.get("weights"))
+    result = simulate(
+        cluster, apps, weights=body.get("weights"),
+        resident=_request_resident(body),
+    )
     return _format_result(result)
 
 
@@ -382,7 +453,9 @@ def _simulate_scenario_group(bodies: list) -> list:
         Scenario(name=f"req-{i}", weights=b.get("weights"))
         for i, b in enumerate(bodies)
     ]
-    results = simulate_batch(cluster, apps, scenarios)
+    results = simulate_batch(
+        cluster, apps, scenarios, resident=_request_resident(bodies[0])
+    )
     metrics.COALESCED_BATCH.observe(len(bodies), mode="scenarios")
     return [_format_result(r) for r in results]
 
@@ -619,10 +692,12 @@ class _Handler(BaseHTTPRequestHandler):
         # 429/503 + Retry-After (shed), 504 (deadline mid-simulate), or 500
         # (worker death, counted in osim_requests_dropped_total).
         queue = self.server.admission
+        key, fence_epoch = _coalesce_key_for(self.path, body)
         ticket = queue.submit(
             body,
-            key=_coalesce_key_for(self.path, body),
+            key=key,
             deadline_ms=deadline_ms,
+            fence_epoch=fence_epoch,
         )
         queue.wait(ticket)
         self._send(ticket.code, ticket.payload or {}, headers=ticket.headers)
@@ -657,12 +732,22 @@ def serve(
     default_deadline_ms: Optional[float] = None,
 ) -> int:
     global _kubeconfig, _master, _snapshot, _snapshot_at, _current_server
+    global _resident, _snapshot_stale
     _resolve_env_config()
     _kubeconfig = kubeconfig or None
     _master = master
-    # a previous serve() in this process may have cached a snapshot of a
-    # DIFFERENT cluster — never serve it against the new config
+    # A previous serve() in this process may have cached a snapshot (and
+    # resident planes) of a DIFFERENT cluster — never serve them against the
+    # new config. _snapshot_fetches deliberately SURVIVES the reset: it must
+    # stay monotonic across re-serves, because a coalesce key minted as
+    # "...:gen3" by the old serve would otherwise collide with "...:gen3" of
+    # the new cluster once the counter restarted — same key, different work,
+    # one (wrong) shared response. With a resident the generation is its
+    # epoch, drawn from a module-global counter in engine/resident.py that is
+    # never reused across instances, which subsumes this counter entirely;
+    # the surviving _snapshot_fetches covers the OSIM_RESIDENT=0 path.
     _snapshot, _snapshot_at = None, 0.0
+    _resident, _snapshot_stale = None, False
     httpd = _DrainingHTTPServer(
         ("127.0.0.1", port),
         _Handler,

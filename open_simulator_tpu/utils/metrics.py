@@ -539,6 +539,47 @@ REQUEST_LATENCY = REGISTRY.histogram(
     "osim_server_request_duration_seconds",
     "Admission-to-response latency of POST simulation requests, seconds.",
 )
+RESIDENT_DRIFT_REPAIRS = REGISTRY.counter(
+    "osim_resident_drift_repairs_total",
+    "Anti-entropy repairs (full re-encode) of the resident cluster state, by "
+    "trigger: digest_mismatch (drift detector), torn_delta (partial apply), "
+    "delta_budget (too many deltas since last full encode), disabled "
+    "(OSIM_RESIDENT=0 forced degrade).",
+    labelnames=("reason",),
+)
+RESIDENT_DELTAS = REGISTRY.counter(
+    "osim_resident_deltas_total",
+    "Deltas applied to the resident cluster state without a full re-encode, "
+    "by kind (pod_usage = bind/unbind changed a node's free planes, "
+    "node_row = a node object changed, node_added).",
+    labelnames=("kind",),
+)
+RESIDENT_FALLBACKS = REGISTRY.counter(
+    "osim_resident_fallbacks_total",
+    "Requests or syncs that declined the resident fast path and re-encoded "
+    "from scratch for a structural reason (node_removed, node_order, "
+    "bucket_overflow, shape_growth, not_covering, disabled).",
+    labelnames=("reason",),
+)
+RESIDENT_VERIFICATIONS = REGISTRY.counter(
+    "osim_resident_verifications_total",
+    "Drift-detector digest cross-checks of the resident state against a full "
+    "re-encode, by outcome (ok | mismatch).",
+    labelnames=("outcome",),
+)
+RESIDENT_EPOCH = REGISTRY.gauge(
+    "osim_resident_epoch",
+    "Current generation of the resident cluster state; bumps on every delta "
+    "apply and every repair. Globally monotonic across re-serves.",
+)
+ADMISSION_FENCE = REGISTRY.counter(
+    "osim_admission_fence_total",
+    "Generation-fence decisions at admission dequeue: current = ticket ran "
+    "against the epoch it was submitted under, rekeyed = the resident epoch "
+    "moved between submit and dequeue so the ticket was re-keyed to prevent "
+    "cross-generation coalescing.",
+    labelnames=("outcome",),
+)
 
 # Span names that map onto a dedicated kube-parity histogram; everything
 # else lands only in osim_span_duration_seconds{span=...}.

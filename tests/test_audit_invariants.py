@@ -176,7 +176,7 @@ def test_fixture_findings_are_deterministic_json():
 
 
 # ---------------------------------------------------------------------------
-# tier-1 gate: the production kernels prove clean, all 13 entries covered
+# tier-1 gate: the production kernels prove clean, all 16 entries covered
 # ---------------------------------------------------------------------------
 
 @pytest.fixture(scope="module")
@@ -190,13 +190,14 @@ def test_all_registered_entries_prove_clean(audit):
 
     proved = {e.entry for e in audit.entries}
     assert proved == set(REQUIRED_COVERAGE)
-    assert len(proved) == 13
+    assert len(proved) == 16
 
 
 def test_mask_outputs_proved_binary(audit):
     by_name = {e.entry: e for e in audit.entries}
     assert by_name["ops.fast:domain_select"].bool_outputs >= 1
     assert by_name["ops.kernels:probe_step"].bool_outputs >= 1
+    assert by_name["ops.delta:apply_flags"].bool_outputs >= 1
 
 
 def test_all_score_plugins_prove_range(audit):

@@ -1,0 +1,534 @@
+"""Resident cluster state (engine/resident.py, ops/delta.py, server wiring).
+
+The load-bearing property is *byte identity*: after every sync, the resident
+planes — host mirror AND the device copies — must equal a fresh
+`encode_nodes` of the same (nodes, bound pods) through the same encoder, at
+the resident bucket shapes. The randomized sequence tests drive 200+ delta
+syncs through every mutation class (pod bind/unbind, relabel, cordon, node
+add/remove, no-op) and assert that identity after each step.
+
+The chaos tests prove the robustness envelope: an injected torn delta or
+digest mismatch produces a journaled anti-entropy repair with exact counter
+accounting and a state that is byte-identical afterwards — never a wrong
+answer, never an exception out of sync(). Fencing tests prove the admission
+queue re-keys tickets whose generation moved before dequeue (including the
+stale_generation chaos sentinel) and that epochs never collide across
+resident instances (the re-serve bug class).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from open_simulator_tpu.core.objects import Node, Pod
+from open_simulator_tpu.engine import resident as resident_mod
+from open_simulator_tpu.engine.resident import ResidentCluster
+from open_simulator_tpu.engine.simulator import (
+    AppResource,
+    ClusterResource,
+    simulate,
+)
+from open_simulator_tpu.ops import delta as delta_ops
+from open_simulator_tpu.ops.encode import NodeTable, encode_nodes
+from open_simulator_tpu.resilience import faults
+from open_simulator_tpu.server.admission import AdmissionQueue, coalesce_key
+from open_simulator_tpu.utils import metrics
+
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# fixtures / helpers
+# ---------------------------------------------------------------------------
+
+
+def mknode(name, cpu="8", mem="16Gi", labels=None, unschedulable=False):
+    return Node.from_dict(
+        {
+            "metadata": {"name": name, "labels": dict(labels or {})},
+            "spec": {"unschedulable": unschedulable},
+            "status": {
+                "allocatable": {"cpu": cpu, "memory": mem, "pods": "110"}
+            },
+        }
+    )
+
+
+def mkpod(name, node, cpu="1", mem="1Gi"):
+    return Pod.from_dict(
+        {
+            "metadata": {"name": name, "namespace": "rt"},
+            "spec": {
+                "nodeName": node,
+                "containers": [
+                    {
+                        "name": "c",
+                        "image": "img",
+                        "resources": {"requests": {"cpu": cpu, "memory": mem}},
+                    }
+                ],
+            },
+        }
+    )
+
+
+def assert_byte_identical(res: ResidentCluster):
+    """The correctness contract: resident planes == fresh encode of the
+    adopted (nodes, bound) through the SAME encoder at resident shapes,
+    compared as raw bytes (NaN payloads and signed zeros included)."""
+    fresh = encode_nodes(
+        res.enc,
+        res._nodes,
+        existing_usage=res._usage,
+        existing_gpu=res._gpu_usage,
+        n_pad=res._host.n,
+        min_axes=res._axes,
+    )
+    for f in dataclasses.fields(NodeTable):
+        if f.name == "names":
+            continue
+        a, b = getattr(res._host, f.name), getattr(fresh, f.name)
+        assert a.shape == b.shape and a.dtype == b.dtype, f.name
+        assert a.tobytes() == b.tobytes(), f"host plane {f.name} diverged"
+    assert res._host.names == fresh.names
+    for name in resident_mod.DEVICE_PLANES:
+        dv = np.asarray(res._dev[name])
+        assert dv.tobytes() == getattr(fresh, name).tobytes(), (
+            f"device plane {name} diverged from fresh encode"
+        )
+
+
+def repair_count(reason: str) -> float:
+    return metrics.RESIDENT_DRIFT_REPAIRS.value(reason=reason)
+
+
+def plan(op: str, kind: str, times: int = 1) -> faults.FaultPlan:
+    return faults.FaultPlan.from_dict(
+        {
+            "rules": [
+                {"target": "resident", "op": op, "kind": kind, "times": times}
+            ]
+        }
+    )
+
+
+# ---------------------------------------------------------------------------
+# delta kernels (ops/delta.py)
+# ---------------------------------------------------------------------------
+
+
+def test_digest_fold_host_matches_device_bit_patterns():
+    rng = np.random.default_rng(0)
+    f = rng.standard_normal((13, 7)).astype(np.float32)
+    # the digest must see raw bit patterns: NaN, -0.0, +/-inf included
+    f[0, 0] = np.nan
+    f[1, 1] = -0.0
+    f[2, 2] = np.inf
+    f[3, 3] = -np.inf
+    assert int(delta_ops.digest_fold(jnp.asarray(f))) == (
+        delta_ops.digest_fold_host(f)
+    )
+    i = rng.integers(-5, 5, (9, 4)).astype(np.int32)
+    assert int(delta_ops.digest_fold(jnp.asarray(i))) == (
+        delta_ops.digest_fold_host(i)
+    )
+    b = rng.random((17,)) < 0.5
+    assert int(delta_ops.digest_fold(jnp.asarray(b))) == (
+        delta_ops.digest_fold_host(b)
+    )
+
+
+def test_digest_distinguishes_permutation_and_zero_fill():
+    a = np.arange(8, dtype=np.float32)
+    perm = a[::-1].copy()
+    assert delta_ops.digest_fold_host(a) != delta_ops.digest_fold_host(perm)
+    assert delta_ops.digest_fold_host(a) != delta_ops.digest_fold_host(
+        np.zeros_like(a)
+    )
+
+
+def test_apply_rows_drops_pad_slots():
+    arr = jnp.asarray(np.arange(12, dtype=np.float32).reshape(4, 3))
+    idx = delta_ops.pad_indices([1], 4)  # pad slots hold n=4 -> dropped
+    assert idx.shape[0] == 8 and set(idx[1:]) == {4}
+    rows = np.zeros((8, 3), np.float32)
+    rows[0] = 99.0
+    out = np.asarray(delta_ops.apply_rows(arr, jnp.asarray(idx), jnp.asarray(rows)))
+    assert (out[1] == 99.0).all()
+    # rows 0/2/3 untouched — a clamped pad slot would have smashed row 3
+    assert out[0].tolist() == [0, 1, 2] and out[3].tolist() == [9, 10, 11]
+
+
+# ---------------------------------------------------------------------------
+# randomized delta sequences: byte identity after every step
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_random_delta_sequences_byte_identical(seed, tmp_path):
+    """12 seeds x 20 steps = 240 random delta syncs, byte-compared against a
+    fresh encode after every one. Mutations cover usage deltas, node-row
+    deltas, node adds (in-bucket), removals (structural fallback), and
+    no-ops; epochs must be monotonic and only move when state moved."""
+    rng = np.random.default_rng(seed)
+    nodes = [
+        mknode(f"n{seed}-{i}", labels={"zone": f"az-{i % 3}"}) for i in range(8)
+    ]
+    pods = []
+    serial = 0
+    res = ResidentCluster(journal_dir=str(tmp_path))
+    res.sync(nodes, pods)
+    assert_byte_identical(res)
+    repairs_before = res.repairs
+    last_epoch = res.epoch
+    for step in range(20):
+        action = rng.choice(
+            ["bind", "unbind", "relabel", "cordon", "add_node",
+             "remove_node", "noop"],
+            p=[0.35, 0.15, 0.15, 0.1, 0.1, 0.05, 0.1],
+        )
+        if action == "bind":
+            serial += 1
+            target = nodes[rng.integers(len(nodes))].name
+            pods.append(
+                mkpod(f"p{seed}-{serial}", target,
+                      cpu=str(1 + int(rng.integers(3))))
+            )
+        elif action == "unbind" and pods:
+            pods.pop(int(rng.integers(len(pods))))
+        elif action == "relabel":
+            i = int(rng.integers(len(nodes)))
+            raw = {k: v for k, v in nodes[i].raw.items()}
+            meta = dict(raw.get("metadata") or {})
+            labels = dict(meta.get("labels") or {})
+            labels["step"] = f"s{step}"
+            meta["labels"] = labels
+            raw["metadata"] = meta
+            nodes[i] = Node.from_dict(raw)
+        elif action == "cordon":
+            i = int(rng.integers(len(nodes)))
+            raw = {k: v for k, v in nodes[i].raw.items()}
+            spec = dict(raw.get("spec") or {})
+            spec["unschedulable"] = not spec.get("unschedulable", False)
+            raw["spec"] = spec
+            nodes[i] = Node.from_dict(raw)
+        elif action == "add_node":
+            serial += 1
+            nodes.append(mknode(f"n{seed}-new{serial}"))
+        elif action == "remove_node" and len(nodes) > 2:
+            i = int(rng.integers(len(nodes)))
+            gone = nodes.pop(i)
+            pods = [p for p in pods if p.node_name != gone.name]
+        epoch = res.sync(nodes, pods)
+        assert epoch >= last_epoch
+        last_epoch = epoch
+        assert_byte_identical(res)
+        assert res.covers_reason(
+            nodes, [(p, p.node_name) for p in pods]
+        ) is None
+    # the whole walk was delta-expressible or structurally re-encoded —
+    # never a drift repair
+    assert res.repairs == repairs_before
+    assert res.verify_now() is True
+
+
+def test_noop_sync_holds_epoch_and_mutation_bumps_it(tmp_path):
+    nodes = [mknode("a"), mknode("b")]
+    res = ResidentCluster(journal_dir=str(tmp_path))
+    e1 = res.sync(nodes, [])
+    assert e1 == res.sync(nodes, [])  # no-op: same epoch, key stability
+    e2 = res.sync(nodes, [mkpod("p1", "a")])
+    assert e2 > e1
+    assert_byte_identical(res)
+
+
+# ---------------------------------------------------------------------------
+# chaos: every injected fault becomes a journaled repair, never a wrong
+# answer — with exact counter accounting
+# ---------------------------------------------------------------------------
+
+
+def test_torn_delta_repairs_and_journals(tmp_path):
+    nodes = [mknode("a"), mknode("b")]
+    res = ResidentCluster(journal_dir=str(tmp_path))
+    res.sync(nodes, [])
+    before = repair_count("torn_delta")
+    with faults.injected(plan("apply", "torn_delta")):
+        res.sync(nodes, [mkpod("p1", "a")])
+    assert res.repairs == 1
+    assert repair_count("torn_delta") == before + 1
+    assert_byte_identical(res)  # the partial device apply was healed
+    events = res._journal.events("resident_repair")
+    assert len(events) == 1
+    assert events[0]["reason"] == "torn_delta"
+    assert events[0]["epoch"] == res.epoch
+    # the stream keeps working after the repair
+    res.sync(nodes, [mkpod("p1", "a"), mkpod("p2", "b")])
+    assert_byte_identical(res)
+
+
+def test_digest_mismatch_detected_and_repaired(tmp_path):
+    nodes = [mknode("a"), mknode("b"), mknode("c")]
+    res = ResidentCluster(journal_dir=str(tmp_path))
+    res.sync(nodes, [mkpod("p1", "a")])
+    before = repair_count("digest_mismatch")
+    mismatches = metrics.RESIDENT_VERIFICATIONS.value(outcome="mismatch")
+    with faults.injected(plan("verify", "digest_mismatch")):
+        assert res.verify_now() is False
+    assert res.repairs == 1
+    assert repair_count("digest_mismatch") == before + 1
+    assert metrics.RESIDENT_VERIFICATIONS.value(outcome="mismatch") == (
+        mismatches + 1
+    )
+    assert res._journal.has("resident_repair")
+    assert_byte_identical(res)
+    assert res.verify_now() is True  # fault exhausted: detector is clean
+
+
+def test_periodic_verify_fires_on_cadence(tmp_path, monkeypatch):
+    monkeypatch.setenv("OSIM_RESIDENT_VERIFY_EVERY", "2")
+    nodes = [mknode("a"), mknode("b")]
+    res = ResidentCluster(journal_dir=str(tmp_path))
+    res.sync(nodes, [])
+    ok_before = metrics.RESIDENT_VERIFICATIONS.value(outcome="ok")
+    res.sync(nodes, [mkpod("p1", "a")])
+    res.sync(nodes, [mkpod("p1", "a"), mkpod("p2", "b")])  # 2nd delta
+    assert metrics.RESIDENT_VERIFICATIONS.value(outcome="ok") == ok_before + 1
+
+
+def test_delta_budget_exhaustion_repairs(tmp_path, monkeypatch):
+    monkeypatch.setenv("OSIM_RESIDENT_DELTA_BUDGET", "2")
+    nodes = [mknode("a"), mknode("b")]
+    res = ResidentCluster(journal_dir=str(tmp_path))
+    res.sync(nodes, [])
+    before = repair_count("delta_budget")
+    res.sync(nodes, [mkpod("p1", "a")])
+    assert repair_count("delta_budget") == before  # 1 delta: under budget
+    res.sync(nodes, [mkpod("p1", "a"), mkpod("p2", "b")])
+    assert repair_count("delta_budget") == before + 1
+    assert res.repairs == 1
+    assert_byte_identical(res)
+    # the re-encode reset the budget: the next delta is cheap again
+    res.sync(nodes, [mkpod("p2", "b")])
+    assert repair_count("delta_budget") == before + 1
+
+
+def test_mid_run_disable_is_a_counted_repair(tmp_path, monkeypatch):
+    nodes = [mknode("a"), mknode("b")]
+    res = ResidentCluster(journal_dir=str(tmp_path))
+    res.sync(nodes, [])
+    assert res.covers_reason(nodes, []) is None
+    before = repair_count("disabled")
+    monkeypatch.setenv("OSIM_RESIDENT", "0")
+    res.sync(nodes, [mkpod("p1", "a")])
+    assert repair_count("disabled") == before + 1
+    assert res.covers_reason(nodes, [(mkpod("p1", "a"), "a")]) == "disabled"
+    # flipping back re-enables the delta path without another repair
+    monkeypatch.setenv("OSIM_RESIDENT", "1")
+    res.sync(nodes, [mkpod("p1", "a")])
+    assert repair_count("disabled") == before + 1
+    assert res.covers_reason(nodes, [(mkpod("p1", "a"), "a")]) is None
+    assert_byte_identical(res)
+
+
+def test_structural_changes_are_fallbacks_not_repairs(tmp_path):
+    nodes = [mknode("a"), mknode("b"), mknode("c")]
+    res = ResidentCluster(journal_dir=str(tmp_path))
+    res.sync(nodes, [])
+    removed_before = metrics.RESIDENT_FALLBACKS.value(reason="node_removed")
+    res.sync(nodes[:2], [])  # node c vanished
+    assert metrics.RESIDENT_FALLBACKS.value(reason="node_removed") == (
+        removed_before + 1
+    )
+    assert res.repairs == 0  # structural != drift
+    assert_byte_identical(res)
+    # reorder is its own reason
+    order_before = metrics.RESIDENT_FALLBACKS.value(reason="node_order")
+    res.sync([nodes[1], nodes[0]], [])
+    assert metrics.RESIDENT_FALLBACKS.value(reason="node_order") == (
+        order_before + 1
+    )
+    assert_byte_identical(res)
+
+
+# ---------------------------------------------------------------------------
+# generation fencing
+# ---------------------------------------------------------------------------
+
+
+def test_epochs_never_collide_across_instances(tmp_path):
+    """The re-serve bug class: a new ResidentCluster (new serve()) must not
+    mint epochs an old instance already used — coalesce keys survive."""
+    r1 = ResidentCluster(journal_dir=str(tmp_path / "a"))
+    r1.sync([mknode("a")], [])
+    r2 = ResidentCluster(journal_dir=str(tmp_path / "b"))
+    r2.sync([mknode("a")], [])
+    assert r2.epoch > r1.epoch
+
+
+def test_fence_rekeys_ticket_when_epoch_moves(tmp_path):
+    nodes = [mknode("a"), mknode("b")]
+    res = ResidentCluster(journal_dir=str(tmp_path))
+    res.sync(nodes, [])
+    q = AdmissionQueue(
+        lambda bodies: [{"ok": True} for _ in bodies],
+        depth=8, coalesce_ms=0, default_deadline_ms=0,
+        fence=res.fence_epoch,
+    )
+    current_before = metrics.ADMISSION_FENCE.value(outcome="current")
+    rekeyed_before = metrics.ADMISSION_FENCE.value(outcome="rekeyed")
+    t1 = q.submit({"a": 1}, key=f"k:gen{res.epoch}", fence_epoch=res.epoch)
+    res.sync(nodes, [mkpod("p1", "a")])  # epoch moves before dequeue
+    t2 = q.submit({"a": 1}, key=f"k:gen{res.epoch}", fence_epoch=res.epoch)
+    t3 = q.submit({"b": 2}, key="unfenced")  # no fence_epoch: untouched
+    q.run_pending()
+    assert t1.code == t2.code == t3.code == 200
+    assert t1.key == f"k:gen{res.epoch - 1}@fence{res.epoch}" or t1.key.endswith(
+        f"@fence{res.epoch}"
+    )
+    assert t2.key == f"k:gen{res.epoch}"  # admitted at the current epoch
+    assert t3.key == "unfenced"
+    assert metrics.ADMISSION_FENCE.value(outcome="rekeyed") == rekeyed_before + 1
+    assert metrics.ADMISSION_FENCE.value(outcome="current") == current_before + 1
+
+
+def test_stale_generation_chaos_forces_rekey(tmp_path):
+    res = ResidentCluster(journal_dir=str(tmp_path))
+    res.sync([mknode("a")], [])
+    q = AdmissionQueue(
+        lambda bodies: [{"ok": True} for _ in bodies],
+        depth=8, coalesce_ms=0, default_deadline_ms=0,
+        fence=res.fence_epoch,
+    )
+    t = q.submit({"a": 1}, key=f"k:gen{res.epoch}", fence_epoch=res.epoch)
+    with faults.injected(plan("fence", "stale_generation")):
+        q.run_pending()
+    assert t.code == 200  # degraded to a private key, never a wrong merge
+    assert t.key.endswith("@fence-1")
+
+
+def test_coalesce_key_stale_dimension():
+    body = {"apps": []}
+    fresh = coalesce_key("/api/deploy-apps", body, generation=7)
+    stale = coalesce_key("/api/deploy-apps", body, generation=7, stale=True)
+    assert fresh != stale and stale.endswith(":stale")
+    # staleness is only meaningful for generation-keyed (live) requests
+    assert coalesce_key("/p", body) == coalesce_key("/p", body, stale=True)
+
+
+# ---------------------------------------------------------------------------
+# simulator equivalence + server wiring
+# ---------------------------------------------------------------------------
+
+
+def _deployment(name, replicas, cpu="1"):
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": "rt"},
+        "spec": {
+            "replicas": replicas,
+            "template": {
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "c",
+                            "image": "img",
+                            "resources": {
+                                "requests": {"cpu": cpu, "memory": "1Gi"}
+                            },
+                        }
+                    ]
+                }
+            },
+        },
+    }
+
+
+def _placement_nodes(result):
+    return sorted(
+        st.node.name for st in result.node_status for _ in st.pods
+    )
+
+
+def test_simulate_with_resident_matches_plain(tmp_path):
+    nodes = [mknode(f"s{i}", labels={"zone": f"az-{i % 2}"}) for i in range(6)]
+    pods = [mkpod("pre1", "s0"), mkpod("pre2", "s1", cpu="2")]
+    res = ResidentCluster(journal_dir=str(tmp_path))
+    res.sync(nodes, pods)
+    apps = [AppResource(name="a", objects=[_deployment("d", 5)])]
+
+    def cluster():
+        return ClusterResource(nodes=list(nodes), pods=list(pods))
+
+    fallbacks_before = metrics.RESIDENT_FALLBACKS.snapshot()
+    plain = simulate(cluster(), apps)
+    fast = simulate(cluster(), apps, resident=res)
+    assert _placement_nodes(plain) == _placement_nodes(fast)
+    # the fast path was actually taken: no fallback reason was recorded
+    assert metrics.RESIDENT_FALLBACKS.snapshot() == fallbacks_before
+    # and it holds across a delta: bind one more pod, both paths agree again
+    pods.append(mkpod("pre3", "s2"))
+    res.sync(nodes, pods)
+    plain2 = simulate(cluster(), apps)
+    fast2 = simulate(cluster(), apps, resident=res)
+    assert _placement_nodes(plain2) == _placement_nodes(fast2)
+    assert_byte_identical(res)
+
+
+def test_simulate_falls_back_when_not_covering(tmp_path):
+    nodes = [mknode("f0"), mknode("f1")]
+    res = ResidentCluster(journal_dir=str(tmp_path))
+    res.sync(nodes, [])
+    before = metrics.RESIDENT_FALLBACKS.value(reason="not_covering")
+    other = ClusterResource(nodes=[mknode("f0"), mknode("other")], pods=[])
+    out = simulate(other, [AppResource(name="a", objects=[_deployment("d", 1)])],
+                   resident=res)
+    assert metrics.RESIDENT_FALLBACKS.value(reason="not_covering") == before + 1
+    assert len(_placement_nodes(out)) == 1  # answer is still correct
+
+
+def test_server_refresh_creates_and_fences_resident(monkeypatch, tmp_path):
+    from unittest import mock
+
+    import open_simulator_tpu.utils.kubeclient as kc
+    from open_simulator_tpu.server import server as srv
+
+    snap = ClusterResource(nodes=[mknode("l0"), mknode("l1")], pods=[])
+    monkeypatch.setattr(srv, "_kubeconfig", "fake")
+    monkeypatch.setattr(srv, "_master", "")
+    monkeypatch.setattr(srv, "_snapshot", None)
+    monkeypatch.setattr(srv, "_snapshot_at", 0.0)
+    monkeypatch.setattr(srv, "_resident", None)
+    monkeypatch.setattr(srv, "_snapshot_stale", False)
+    with mock.patch.object(
+        kc, "create_cluster_resource_from_kubeconfig", return_value=snap
+    ):
+        srv._live_snapshot()
+    assert srv._resident is not None
+    gen, stale = srv._snapshot_generation()
+    assert gen == srv._resident.epoch and stale is False
+    key, fence = srv._coalesce_key_for("/api/deploy-apps", {"apps": []})
+    assert f":gen{gen}" in key and fence == gen
+    # a body that carries its own cluster is neither keyed nor fenced
+    key2, fence2 = srv._coalesce_key_for(
+        "/api/deploy-apps", {"cluster": {"objects": [{"kind": "Node"}]}}
+    )
+    assert "gen" not in key2 and fence2 is None
+    # failed refresh: stale flag flips, key grows the :stale dimension
+    monkeypatch.setattr(srv, "_snapshot_at", -1e9)
+    with mock.patch.object(
+        kc,
+        "create_cluster_resource_from_kubeconfig",
+        side_effect=kc.KubeClientError("boom"),
+    ):
+        srv._live_snapshot()
+    key3, _ = srv._coalesce_key_for("/api/deploy-apps", {"apps": []})
+    assert key3.endswith(":stale")
+    # recovery clears it
+    with mock.patch.object(
+        kc, "create_cluster_resource_from_kubeconfig", return_value=snap
+    ):
+        srv._live_snapshot()
+    assert srv._snapshot_generation()[1] is False
