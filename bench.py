@@ -597,6 +597,126 @@ def config_multi_scenario(n_scenarios=64, n_nodes=64, n_pods=400):
     }
 
 
+def config_warm_start():
+    """Config: the compile-lifecycle headline. Cold leg: `simon warmup`'s
+    engine — AOT-compile every audited jit entry at canonical shapes plus
+    the capacity-sweep rehearsal, banking the persistent compile cache;
+    ALL compile time lives here. Warm leg: the identical full capacity
+    sweep re-run against warm caches under CompileCounter, demanding ZERO
+    cold compiles — so the warm wall-clock excludes compile time by
+    construction (a counted invariant), not by subtraction."""
+    from open_simulator_tpu.analysis.jaxpr_audit import _run_sweeps
+    from open_simulator_tpu.engine.warmup import run_warmup
+    from open_simulator_tpu.ops.fast import reset_scenario_programs
+    from open_simulator_tpu.utils.platform import CompileCounter
+
+    t0 = time.time()
+    report = run_warmup()
+    cold_s = time.time() - t0
+    reset_scenario_programs()
+    t1 = time.time()
+    with CompileCounter() as counter:
+        plan, plan_b = _run_sweeps()
+    warm_s = time.time() - t1
+    out = {
+        "wall_s": round(warm_s, 2),
+        "cold_wall_s": round(cold_s, 2),
+        "warm_wall_s": round(warm_s, 2),
+        "warm_speedup": round(cold_s / warm_s, 1) if warm_s > 0 else None,
+        "warmup_entries": len(report.entries),
+        "warmup_cold_compiles": report.cold_compiles,
+        "warm_backend_compiles": counter.backend_compiles,
+        "warm_persistent_hits": counter.persistent_hits,
+        "warm_cold_compiles": counter.cold_compiles,
+        "nodes_added": plan.nodes_added,
+        "batched_nodes_added": plan_b.nodes_added,
+        "cache_dir": report.cache_dir,
+    }
+    if not report.ok:
+        out["error"] = f"warmup missed audited entries: {report.missing}"
+    elif counter.cold_compiles != 0:
+        out["error"] = (
+            f"warm leg paid {counter.cold_compiles} cold compile(s); "
+            "warm start must exclude all compile time"
+        )
+    elif plan.nodes_added != plan_b.nodes_added:
+        out["error"] = (
+            f"serial/batched sweep answers diverged: "
+            f"{plan.nodes_added} vs {plan_b.nodes_added}"
+        )
+    return out
+
+
+def config_sharded_smoke(n_scenarios=8, n_nodes=24, n_pods=120):
+    """Config: scenario-axis sharding equivalence. The same what-if sweep
+    runs unsharded and sharded across a 2-device mesh (scenario lanes split
+    over devices, node tensors replicated — parallel/mesh.shard_scenarios);
+    per-lane placements and unscheduled reasons must be byte-identical.
+    _run_segment provisions the 2 virtual CPU devices for this segment via
+    --xla_force_host_platform_device_count, so it runs in every CI lane."""
+    import jax
+
+    from open_simulator_tpu.core.workloads import reset_name_rng
+    from open_simulator_tpu.engine.simulator import (
+        AppResource,
+        ClusterResource,
+        Scenario,
+        simulate_batch,
+    )
+    from open_simulator_tpu.parallel.mesh import product_mesh
+
+    ndev = len(jax.devices())
+    if ndev < 2:
+        return {"error": f"sharded smoke needs >=2 devices, have {ndev}"}
+
+    def _digest(r) -> str:
+        doc = {
+            "placements": {
+                st.node.name: sorted(p.key for p in st.pods)
+                for st in r.node_status
+            },
+            "unscheduled": sorted(
+                (u.pod.key, u.reason) for u in r.unscheduled
+            ),
+        }
+        return json.dumps(doc, sort_keys=True)
+
+    nodes = [_mk_node(f"n-{i}", "8", "16Gi") for i in range(n_nodes)]
+    cluster = ClusterResource(nodes=nodes)
+    apps = [AppResource(
+        name="bench", objects=[_mk_deploy("web", n_pods, "500m", "1Gi")]
+    )]
+    scenarios = [
+        Scenario(name=f"s-{i}", node_count=n_nodes // 2 + i)
+        for i in range(n_scenarios)
+    ]
+    reset_name_rng()
+    t0 = time.time()
+    base = simulate_batch(cluster, apps, scenarios)
+    unsharded_s = time.time() - t0
+    mesh = product_mesh(2)
+    reset_name_rng()
+    t1 = time.time()
+    sharded = simulate_batch(cluster, apps, scenarios, mesh=mesh)
+    sharded_s = time.time() - t1
+    mismatches = [
+        sc.name
+        for sc, a, b in zip(scenarios, base, sharded)
+        if _digest(a) != _digest(b)
+    ]
+    out = {
+        "wall_s": round(sharded_s, 2),
+        "unsharded_wall_s": round(unsharded_s, 2),
+        "sharded_wall_s": round(sharded_s, 2),
+        "devices": ndev,
+        "scenarios": n_scenarios,
+        "lanes_identical": not mismatches,
+    }
+    if mismatches:
+        out["error"] = f"sharded lanes diverged: {mismatches}"
+    return out
+
+
 def config_preempt(n_nodes=60, n_low=400, n_high=100):
     """Config 6: priority-tiered preemption. A low-priority tier fills the
     cluster (400 x 1cpu on 60 x 8cpu = 80 cpu headroom), then a
@@ -1010,6 +1130,8 @@ CONFIGS = {
     "plan_100k_10k": config_plan,
     "capacity_sweep_batched": config_capacity_sweep,
     "multi_scenario_64": config_multi_scenario,
+    "warm_start_100k": config_warm_start,
+    "sharded_2dev_smoke": config_sharded_smoke,
     "preempt_tiered": config_preempt,
     "extender_1k": config_extender,
     "serving_concurrent": config_serving_concurrent,
@@ -1126,6 +1248,8 @@ SEGMENT_TIMEOUT_S = {
     "plan_100k_10k": 1200.0,
     "capacity_sweep_batched": 900.0,
     "multi_scenario_64": 600.0,
+    "warm_start_100k": 900.0,
+    "sharded_2dev_smoke": 600.0,
     "preempt_tiered": 900.0,
     "extender_1k": 900.0,
     "serving_concurrent": 600.0,
@@ -1170,6 +1294,16 @@ def _run_segment(name: str, pods: int, nodes: int, platform: str) -> dict:
     env = dict(os.environ)
     if platform:
         env["JAX_PLATFORMS"] = platform
+    if name == "sharded_2dev_smoke":
+        # the sharding-equivalence smoke needs >=2 devices on every CI
+        # lane: provision 2 virtual CPU devices (the flag only affects the
+        # host platform, so this segment is deliberately CPU-pinned — it
+        # proves placement equivalence, not device speed)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=2"
+        ).strip()
     deadline = SEGMENT_TIMEOUT_S.get(name, 900.0)
     cmd = [
         sys.executable, "-u", os.path.abspath(__file__),
@@ -1361,6 +1495,25 @@ def main() -> int:
                 )
         result["capacity_sweep_batched"] = sweep
         result["capacity_sweep_speedup"] = sweep.get("capacity_sweep_speedup")
+        # The compile-lifecycle headline stays in the quick profile: cold
+        # wall (warmup pays every compile) vs warm wall (the same sweep,
+        # zero cold compiles asserted — warm start excludes all compile
+        # time by construction).
+        if "warm_start_100k" in done_segments:
+            print(
+                "bench segment warm_start_100k: replayed from journal",
+                file=sys.stderr, flush=True,
+            )
+            warm = dict(done_segments["warm_start_100k"])
+        else:
+            warm = config_warm_start()
+            if journal is not None and "error" not in warm:
+                journal.append(
+                    "segment", segment="warm_start_100k", result=warm
+                )
+        result["warm_start_100k"] = warm
+        result["cold_wall_s"] = warm.get("cold_wall_s")
+        result["warm_wall_s"] = warm.get("warm_wall_s")
         result.update(backend_info)
         from open_simulator_tpu.utils.metrics import COMPILE_CACHE, REGISTRY
 

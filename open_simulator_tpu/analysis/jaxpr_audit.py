@@ -111,11 +111,23 @@ class TargetReport:
     primitives: List[str] = dataclasses.field(default_factory=list)
     forbidden: List[str] = dataclasses.field(default_factory=list)
     wide_avals: List[str] = dataclasses.field(default_factory=list)
+    #: positional args the entry donates (__osim_donate_argnums__)
+    donated: List[int] = dataclasses.field(default_factory=list)
+    #: donated-invar aliasing findings: a donated arg shared an array object
+    #: with another arg of the same captured call (XLA would scatter into a
+    #: buffer the other argument still reads)
+    donation_aliased: List[str] = dataclasses.field(default_factory=list)
     error: str = ""
 
     @property
     def ok(self) -> bool:
-        return self.traced and not self.forbidden and not self.wide_avals and not self.error
+        return (
+            self.traced
+            and not self.forbidden
+            and not self.wide_avals
+            and not self.donation_aliased
+            and not self.error
+        )
 
     def to_dict(self) -> dict:
         return {
@@ -125,6 +137,8 @@ class TargetReport:
             "n_eqns": self.n_eqns,
             "forbidden": self.forbidden,
             "wide_avals": self.wide_avals,
+            "donated": self.donated,
+            "donation_aliased": self.donation_aliased,
             "error": self.error,
         }
 
@@ -153,10 +167,14 @@ class AuditReport:
         for t in sorted(self.targets, key=lambda t: t.name):
             status = "ok" if t.ok else "FAIL"
             detail = f"{t.n_eqns} eqns"
+            if t.donated:
+                detail += f"; donates arg(s) {t.donated}"
             if t.forbidden:
                 detail += f"; forbidden: {', '.join(t.forbidden)}"
             if t.wide_avals:
                 detail += f"; wide avals: {', '.join(t.wide_avals[:4])}"
+            if t.donation_aliased:
+                detail += f"; DONATION ALIASED: {', '.join(t.donation_aliased)}"
             if t.error:
                 detail += f"; error: {t.error}"
             out.append(f"  {status:4s} {t.name} ({detail})")
@@ -311,11 +329,29 @@ def _capture_calls() -> List[_Captured]:
     entry wrapped by a recorder; return first-call args per entry."""
     import importlib
 
+    import jax
     import jax.numpy as jnp
     import numpy as np
 
     captured: Dict[str, _Captured] = {}
     patches: List[Tuple[Any, str, Any]] = []
+
+    def _snapshot_donated(fn, args: tuple) -> tuple:
+        """Donating entries delete their donated inputs when the recorded
+        call executes; keep copies so the retrace/invariant passes still
+        see live concrete values."""
+        donated = set(getattr(fn, "__osim_donate_argnums__", ()) or ())
+        if not donated:
+            return args
+        return tuple(
+            jax.tree.map(
+                lambda a: a.copy() if hasattr(a, "dtype") else a, arg
+            )
+            if i in donated
+            else arg
+            for i, arg in enumerate(args)
+        )
+
     try:
         for module_name, attrs in AUDIT_TARGETS.items():
             module = importlib.import_module(module_name)
@@ -325,7 +361,12 @@ def _capture_calls() -> List[_Captured]:
 
                 def recorder(*args, _original=original, _name=name, **kwargs):
                     if _name not in captured and _is_concrete((args, kwargs)):
-                        captured[_name] = _Captured(_name, _original, args, kwargs)
+                        captured[_name] = _Captured(
+                            _name,
+                            _original,
+                            _snapshot_donated(_original, args),
+                            kwargs,
+                        )
                     return _original(*args, **kwargs)
 
                 setattr(module, attr, recorder)
@@ -366,15 +407,19 @@ def _capture_calls() -> List[_Captured]:
         # the resident-state delta kernels (engine/resident.py): scatter two
         # rows into the canonical free plane at production shapes (bucketed
         # index vector, pad slots dropped), flag-set on the valid vector,
-        # and one drift-detector digest per representative dtype
+        # and one drift-detector digest per representative dtype. The digest
+        # runs first and the scatters get fresh copies: apply_rows /
+        # apply_flags DONATE their plane argument, and the canonical
+        # carry/ns must stay alive for the retrace of every other entry.
         delta = importlib.import_module("open_simulator_tpu.ops.delta")
         n = int(carry.free.shape[0])
         idx = jnp.asarray(delta.pad_indices([0, 1], n))
         rows = jnp.zeros((int(idx.shape[0]),) + carry.free.shape[1:],
                          carry.free.dtype)
-        delta.apply_rows(carry.free, idx, rows)
-        delta.apply_flags(ns.valid, idx, jnp.zeros(int(idx.shape[0]), bool))
         delta.digest_fold(carry.free)
+        delta.apply_rows(carry.free.copy(), idx, rows)
+        delta.apply_flags(ns.valid.copy(), idx,
+                          jnp.zeros(int(idx.shape[0]), bool))
         del np
     finally:
         for module, attr, original in patches:
@@ -408,8 +453,49 @@ def _sub_jaxprs(v: Any) -> Iterator[Any]:
             yield from _sub_jaxprs(item)
 
 
+def _donation_aliasing(cap: _Captured) -> Tuple[List[int], List[str]]:
+    """Donated-invar alias check: no array object of a donated positional
+    arg may appear in any OTHER argument of the same captured call — XLA
+    aliases donated input buffers to outputs, so a second argument reading
+    the same array would observe the in-place write. Object identity is the
+    right granularity here (donated buffers may already be deleted by the
+    capture run, so pointer comparison is unavailable; the engine only ever
+    aliases by passing the same Array object twice)."""
+    import jax
+
+    donated = sorted(getattr(cap.fn, "__osim_donate_argnums__", ()) or ())
+    findings: List[str] = []
+    if not donated:
+        return donated, findings
+    leaves_by_arg = [
+        (i, [l for l in jax.tree.leaves(a) if hasattr(l, "dtype")])
+        for i, a in enumerate(cap.args)
+    ]
+    kw_leaves = [
+        (k, l)
+        for k, v in sorted(cap.kwargs.items())
+        for l in jax.tree.leaves(v)
+        if hasattr(l, "dtype")
+    ]
+    for d in donated:
+        if d >= len(cap.args):
+            findings.append(f"arg {d} not supplied positionally")
+            continue
+        donated_ids = {id(l) for l in dict(leaves_by_arg)[d]}
+        for i, ls in leaves_by_arg:
+            if i == d:
+                continue
+            if any(id(l) in donated_ids for l in ls):
+                findings.append(f"arg {d} aliased by arg {i}")
+        for k, l in kw_leaves:
+            if id(l) in donated_ids:
+                findings.append(f"arg {d} aliased by kwarg {k!r}")
+    return donated, findings
+
+
 def _audit_one(cap: _Captured) -> TargetReport:
     report = TargetReport(name=cap.name, traced=False)
+    report.donated, report.donation_aliased = _donation_aliasing(cap)
     try:
         closed = cap.fn.trace(*cap.args, **cap.kwargs).jaxpr
     except Exception as exc:  # pragma: no cover - trace failure is a finding
@@ -577,6 +663,33 @@ def _backend_compiles() -> int:
     return int(metrics.COMPILE_CACHE.value(event="backend_compile"))
 
 
+def _run_sweeps():
+    """The canonical capacity sweep, serial then batched — the shared
+    workload of the recompile guard and the warm-start check. Returns
+    (serial plan, batched plan); raises if either fails to converge."""
+    from ..core.workloads import reset_name_rng
+    from ..engine.capacity import plan_capacity
+
+    reset_name_rng()
+    cluster, apps, template = _sweep_fixture()
+    plan = plan_capacity(
+        cluster, apps, template, max_new_nodes=256, sweep_mode="serial"
+    )
+    # the batched half: same fixture through the vmapped scenario
+    # engine, which must (a) reach the same answer and (b) keep every
+    # (node bucket, pod count) program key within its scenario-padding
+    # budget — one padding per sweep phase, not one per call
+    reset_name_rng()
+    cluster_b, apps_b, template_b = _sweep_fixture()
+    plan_b = plan_capacity(
+        cluster_b, apps_b, template_b, max_new_nodes=256,
+        sweep_mode="batched",
+    )
+    if plan is None or plan_b is None:
+        raise RuntimeError("recompile-guard sweep did not converge")
+    return plan, plan_b
+
+
 def run_recompile_guard(budget: int = RECOMPILE_BUDGET) -> GuardResult:
     """Run the canonical capacity sweep and bound its XLA compile count.
 
@@ -585,7 +698,6 @@ def run_recompile_guard(budget: int = RECOMPILE_BUDGET) -> GuardResult:
     local listener count against the registry's
     osim_compile_cache_total{event="backend_compile"} value.
     """
-    from ..engine.capacity import plan_capacity
     from ..utils.platform import install_compile_listener
 
     if not install_compile_listener():
@@ -600,27 +712,12 @@ def run_recompile_guard(budget: int = RECOMPILE_BUDGET) -> GuardResult:
     from jax import monitoring
 
     monitoring.register_event_duration_secs_listener(_local_listener)
-    from ..core.workloads import reset_name_rng
     from ..ops.fast import reset_scenario_programs, scenario_programs
 
     metric_before = _backend_compiles()
     reset_scenario_programs()
     try:
-        reset_name_rng()
-        cluster, apps, template = _sweep_fixture()
-        plan = plan_capacity(
-            cluster, apps, template, max_new_nodes=256, sweep_mode="serial"
-        )
-        # the batched half: same fixture through the vmapped scenario
-        # engine, which must (a) reach the same answer and (b) keep every
-        # (node bucket, pod count) program key within its scenario-padding
-        # budget — one padding per sweep phase, not one per call
-        reset_name_rng()
-        cluster_b, apps_b, template_b = _sweep_fixture()
-        plan_b = plan_capacity(
-            cluster_b, apps_b, template_b, max_new_nodes=256,
-            sweep_mode="batched",
-        )
+        plan, plan_b = _run_sweeps()
     finally:
         try:
             monitoring._unregister_event_duration_listener_by_callback(
@@ -628,8 +725,6 @@ def run_recompile_guard(budget: int = RECOMPILE_BUDGET) -> GuardResult:
             )
         except Exception:
             pass
-    if plan is None or plan_b is None:
-        raise RuntimeError("recompile-guard sweep did not converge")
     metric_delta = _backend_compiles() - metric_before
     return GuardResult(
         compiles=local["n"],
@@ -643,6 +738,93 @@ def run_recompile_guard(budget: int = RECOMPILE_BUDGET) -> GuardResult:
             f"{n}x{p}": sorted(pads)
             for (n, p), pads in scenario_programs().items()
         },
+    )
+
+
+# --------------------------------------------------------------------------
+# warm-start leg
+
+
+@dataclasses.dataclass
+class WarmStartResult:
+    """Outcome of the warm-start check: the full canonical capacity sweep
+    re-run after `simon warmup`, demanding that the persistent compilation
+    cache absorbs every XLA compile request.
+
+    ``cold_compiles`` counts requests the cache did NOT serve (backend
+    compile events minus persistent-cache hits — in this jax version the
+    duration event fires on hits too, so the raw event count alone would
+    indict a perfectly warm cache). Zero cold compiles is the acceptance
+    bar: the sweep may *request* compiles (a fresh process has empty
+    in-process jit caches) but XLA must never actually compile."""
+
+    backend_compiles: int
+    persistent_hits: int
+    nodes_added: int
+    batched_nodes_added: int
+    cache_dir: str = ""
+
+    @property
+    def cold_compiles(self) -> int:
+        return max(0, self.backend_compiles - self.persistent_hits)
+
+    @property
+    def ok(self) -> bool:
+        return (
+            self.cold_compiles == 0
+            and self.nodes_added == self.batched_nodes_added
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "cold_compiles": self.cold_compiles,
+            "backend_compiles": self.backend_compiles,
+            "persistent_hits": self.persistent_hits,
+            "nodes_added": self.nodes_added,
+            "batched_nodes_added": self.batched_nodes_added,
+            "cache_dir": self.cache_dir,
+        }
+
+    def render_text(self) -> str:
+        return (
+            f"warm-start check: {'ok' if self.ok else 'FAILED'} — "
+            f"{self.cold_compiles} cold compile(s) "
+            f"({self.backend_compiles} compile request(s), "
+            f"{self.persistent_hits} persistent-cache hit(s)) over the "
+            f"full capacity sweep; answers "
+            f"{'agree' if self.nodes_added == self.batched_nodes_added else 'DISAGREE'}"
+        )
+
+
+def warm_start_check() -> WarmStartResult:
+    """The warm-start leg of the recompile guard: run the full canonical
+    capacity sweep (serial + batched) and demand ZERO cold compiles.
+
+    Run this after `simon warmup` — in the same process (warmup's sweep
+    rehearsal filled the in-process jit caches) or a later one sharing
+    OSIM_COMPILE_CACHE (every compile request must then persistent-hit).
+    Either way a nonzero cold count means some program the sweep needs was
+    not banked, i.e. the production run would pay a compile inside its
+    capture window."""
+    from ..ops.fast import reset_scenario_programs
+    from ..utils.platform import (
+        CompileCounter,
+        enable_compilation_cache,
+        install_compile_listener,
+    )
+
+    cache_dir = enable_compilation_cache()
+    install_compile_listener()
+    reset_scenario_programs()
+    with CompileCounter() as counter:
+        plan, plan_b = _run_sweeps()
+    return WarmStartResult(
+        backend_compiles=counter.backend_compiles,
+        persistent_hits=counter.persistent_hits,
+        nodes_added=plan.nodes_added,
+        batched_nodes_added=plan_b.nodes_added,
+        cache_dir=cache_dir or "",
     )
 
 

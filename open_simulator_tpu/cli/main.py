@@ -642,6 +642,61 @@ def _run_audit(args) -> int:
     return 0 if report.ok else 1
 
 
+def _add_warmup(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "warmup",
+        help="AOT-compile every audited jit entry into the persistent "
+        "compilation cache",
+        description=(
+            "Compile lifecycle as a phase, not a side effect: enumerate "
+            "the audited jit entries (the same 16 the jaxpr audit proves "
+            "over) at their canonical bucketed shapes, drive each through "
+            "trace().lower().compile(), and rehearse the full capacity "
+            "sweep so every program the engine needs lands in the "
+            "persistent compilation cache (OSIM_COMPILE_CACHE) before "
+            "anything is being timed or deadlined. A later process "
+            "sharing the cache then pays zero cold compiles — "
+            "`simon warmup --check` asserts exactly that and exits "
+            "nonzero otherwise. See docs/performance.md."
+        ),
+    )
+    p.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output format (json is the machine-readable CI artifact)",
+    )
+    p.add_argument(
+        "--no-sweep", action="store_true",
+        help="skip the capacity-sweep rehearsal (registry entries only; "
+        "the zero-cold-compile guarantee then covers only the 16 audited "
+        "programs)",
+    )
+    p.add_argument(
+        "--check", action="store_true",
+        help="run the warm-start check instead of warming: re-run the "
+        "full capacity sweep and demand ZERO cold compiles (exit 1 "
+        "otherwise); run after `simon warmup` in a process sharing "
+        "OSIM_COMPILE_CACHE",
+    )
+
+
+def _run_warmup(args) -> int:
+    import json as _json
+
+    if args.check:
+        from ..analysis.jaxpr_audit import warm_start_check
+
+        result = warm_start_check()
+    else:
+        from ..engine.warmup import run_warmup
+
+        result = run_warmup(include_sweep=not args.no_sweep)
+    if args.format == "json":
+        print(_json.dumps(result.to_dict(), indent=2, sort_keys=True))
+    else:
+        print(result.render_text())
+    return 0 if result.ok else 1
+
+
 def _run_lint(args) -> int:
     import json as _json
 
@@ -701,6 +756,7 @@ def main(argv=None) -> int:
     _add_lint(sub)
     _add_runs(sub)
     _add_sweep(sub)
+    _add_warmup(sub)
     ps = sub.add_parser(
         "server", help="run the REST simulation service",
         description="run the REST simulation service",
@@ -741,7 +797,7 @@ def main(argv=None) -> int:
     pd.add_argument("--output-dir", default="./docs/commandline")
 
     args = parser.parse_args(argv)
-    if args.command in ("apply", "chaos", "server", "runs", "sweep"):
+    if args.command in ("apply", "chaos", "server", "runs", "sweep", "warmup"):
         from ..utils.platform import enable_compilation_cache, ensure_platform
         from ..utils.tracing import init_logging
 
@@ -775,6 +831,8 @@ def main(argv=None) -> int:
         return _run_lint(args)
     if args.command == "sweep":
         return _run_sweep(args)
+    if args.command == "warmup":
+        return _run_warmup(args)
     if args.command == "gen-doc":
         return _gen_doc(parser, args.output_dir)
     if args.command == "server":

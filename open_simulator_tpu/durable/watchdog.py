@@ -151,6 +151,50 @@ def _default_probe() -> str:
     return str(jax.devices()[0])
 
 
+def warmup_requested() -> bool:
+    """OSIM_WARMUP=1 opts runs into the pre-acquisition warmup phase."""
+    return os.environ.get("OSIM_WARMUP", "").strip().lower() in (
+        "1", "true", "yes", "on",
+    )
+
+
+def _warm_phase(deadline_s: float, journal: Any, info: Dict[str, Any]) -> None:
+    """Best-effort AOT warmup right after first device contact: bank every
+    audited jit entry + the sweep rehearsal into the persistent compile
+    cache while nothing is being timed, and journal the outcome so a warm
+    cache is recorded provenance, not luck. A timeout or error is journaled
+    and swallowed — the run proceeds cold rather than dying here (the
+    watchdog still guards every later compile)."""
+    from ..engine.warmup import run_warmup
+
+    try:
+        report = guarded_call(
+            "warmup", run_warmup, deadline_s, journal=journal
+        )
+    except Exception as e:
+        log.warning("warmup phase failed (%s); continuing cold", e)
+        info["warmup"] = {"ok": False, "error": str(e)}
+        if journal is not None:
+            journal.append("warmup_error", error=str(e))
+        return
+    info["warmup"] = {
+        "ok": report.ok,
+        "entries": len(report.entries),
+        "seconds": round(report.seconds, 3),
+        "cold_compiles": report.cold_compiles,
+        "cache_dir": report.cache_dir,
+    }
+    if journal is not None:
+        journal.append(
+            "warmup",
+            ok=report.ok,
+            entries=len(report.entries),
+            seconds=round(report.seconds, 3),
+            cold_compiles=report.cold_compiles,
+            cache_dir=report.cache_dir,
+        )
+
+
 def acquire_backend(
     deadline_s: Optional[float] = None,
     journal: Any = None,
@@ -158,15 +202,30 @@ def acquire_backend(
     probe: Optional[Callable[[], str]] = None,
     clock: Callable[[], float] = time.monotonic,
     poll_s: float = 0.25,
+    warmup: Optional[bool] = None,
 ) -> Dict[str, Any]:
     """Acquire a working JAX backend under a hard deadline, degrading
     TPU→CPU rather than hanging or lying.
 
+    `warmup` (default: OSIM_WARMUP env) runs the AOT warmup phase
+    (engine/warmup.run_warmup) right after first device contact, under its
+    own watchdog deadline, journaling a `warmup` event — so downstream
+    capture windows open against a provably banked compile cache.
+
     Returns a provenance dict — `{"device": ...}` plus, after degradation,
-    `{"fallback": "cpu", "fallback_reason": ...}` — that callers must merge
-    as TOP-LEVEL fields of their output JSON."""
+    `{"fallback": "cpu", "fallback_reason": ...}` (and `{"warmup": ...}`
+    when the phase ran) — that callers must merge as TOP-LEVEL fields of
+    their output JSON."""
     if deadline_s is None:
         deadline_s = backend_deadline_s()
+    if warmup is None:
+        warmup = warmup_requested()
+    if warmup:
+        # the cache dir must be configured before the FIRST compile (the
+        # probe's device touch): jax initializes its persistent-cache
+        # singleton once, and a cache configured after that never serves
+        # hits in this process
+        enable_compilation_cache()
     probe_fn = probe or _default_probe
     info: Dict[str, Any] = {}
 
@@ -180,6 +239,8 @@ def acquire_backend(
         info["device"] = device
         if journal is not None:
             journal.append("backend", device=device)
+        if warmup:
+            _warm_phase(deadline_s, journal, info)
         return info
     except Exception as first_err:  # DeadlineExceeded or a real probe error
         # One journaled retry from the persistent compile cache: warm-cache
@@ -201,6 +262,8 @@ def acquire_backend(
             info["device"] = device
             if journal is not None:
                 journal.append("backend", device=device, retried=True)
+            if warmup:
+                _warm_phase(deadline_s, journal, info)
             return info
         except Exception as second_err:
             reason = (

@@ -183,6 +183,7 @@ class ResidentCluster:
         self._static_epoch = 0
         self._deltas_since_encode = 0
         self._since_verify = 0
+        self._loaned = False
         self._disabled = False
         self._journal = journal
         self._journal_dir = journal_dir
@@ -282,9 +283,17 @@ class ResidentCluster:
         """The resident NodeTable with device planes substituted: numpy
         fields stay host (NodeStatic construction, names lookups), the four
         carry planes are jnp — carry_from_table's jnp.asarray is a no-op, so
-        a request pays zero node-plane transfers."""
+        a request pays zero node-plane transfers.
+
+        Handing out a view LOANS the current device planes to the caller
+        (its Simulator carry aliases them zero-copy). ops/delta.apply_rows
+        donates its input plane, so the next sync must not scatter into a
+        loaned buffer in place — _apply_rows checks the loan flag and feeds
+        the donating kernel a fresh copy instead, leaving every outstanding
+        view intact."""
         with self._lock:
             assert self._host is not None
+            self._loaned = True
             return dataclasses.replace(self._host, **dict(self._dev))
 
     # -- internals (call with self._lock held) -----------------------------
@@ -329,6 +338,7 @@ class ResidentCluster:
         self._static_epoch += 1
         self._deltas_since_encode = 0
         self._since_verify = 0
+        self._loaned = False
 
     def _repair(self, reason: str) -> None:
         """Anti-entropy: re-encode from the mirror of record, journal, count.
@@ -499,11 +509,19 @@ class ResidentCluster:
         U = int(idx.shape[0])
         dev = dict(self._dev)
         planes = DEVICE_PLANES if full_rows else ("free", "gpu_free")
+        # apply_rows donates its plane argument. When no table_view() loan
+        # is outstanding the planes are uniquely ours and the scatter lands
+        # in place (zero-copy delta — the donation win); when a view has
+        # been handed out since the last sync, its borrower's carry aliases
+        # these exact buffers, so donate a fresh copy and leave the loaned
+        # generation intact for its holder.
+        loaned = self._loaned
         for k, name in enumerate(planes):
             src = getattr(table, name)
             stack = np.zeros((U,) + src.shape[1:], src.dtype)
             stack[: len(rows)] = src[rows]
-            dev[name] = delta_ops.apply_rows(dev[name], idx, jnp.asarray(stack))
+            plane = dev[name].copy() if loaned else dev[name]
+            dev[name] = delta_ops.apply_rows(plane, idx, jnp.asarray(stack))
             if torn and k == 0:
                 # genuine partial apply: the first plane landed, the rest
                 # did not — exactly the inconsistency repair must heal
@@ -512,6 +530,9 @@ class ResidentCluster:
                 raise TornDelta("injected by fault plan: torn delta apply")
         self._dev = dev
         self._host = table
+        # the planes just installed are fresh (donated-in-place from our own
+        # generation, or copies when loaned) — no outstanding view holds them
+        self._loaned = False
         if full_rows:
             self._static_epoch += 1
 

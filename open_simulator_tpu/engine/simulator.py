@@ -1399,6 +1399,30 @@ class Simulator:
                 np.stack(weight_rows).astype(np.float32)
             )
             carry_s = stack_carry(self._carry, s_pad)
+            # Under a mesh the sweep shards its LANE axis across the same
+            # devices (scenario lanes are independent — no collectives), with
+            # the node tensors replicated per device. A dedicated local
+            # (ns_sweep, smesh) pair keeps the scenario-mesh placement out of
+            # self._ns, whose node-mesh sharding the serial path owns.
+            smesh = None
+            ns_sweep = self._ns
+            if self.mesh is not None:
+                ndev = int(self.mesh.devices.size)
+                if s_pad % ndev == 0:
+                    from ..parallel.mesh import (
+                        scenario_mesh,
+                        shard_scenarios,
+                    )
+
+                    smesh = scenario_mesh(self.mesh)
+                    ns_sweep, carry_s, valid_s, weights_s = shard_scenarios(
+                        smesh, self._ns, carry_s, valid_s, weights_s
+                    )
+                else:
+                    progress(
+                        "scenario sweep unsharded: %d lanes not divisible "
+                        "by %d devices", s_pad, ndev,
+                    )
             lanes = [
                 {"placed": [], "failed": [], "fail_counts": None}
                 for _ in range(s_real)
@@ -1428,9 +1452,25 @@ class Simulator:
                         continue
                     with span("encode", pods=len(run_pods)):
                         batch = encode_pods(self.enc, run_pods)
+                    ns_prev, carry_prev = self._ns, carry_s
                     carry_s, self._ns = align_carry_scenarios(
                         carry_s, self.enc, self._ns
                     )
+                    if smesh is not None and (
+                        carry_s is not carry_prev
+                        or self._ns is not ns_prev
+                    ):
+                        # growth rebuilt leaves off-mesh; re-pin before the
+                        # next sharded call (identity check above keeps the
+                        # steady state free of redundant device_puts)
+                        ns_sweep, carry_s, valid_s, weights_s = (
+                            shard_scenarios(
+                                smesh, self._ns, carry_s,
+                                valid_s, weights_s,
+                            )
+                        )
+                    elif smesh is None:
+                        ns_sweep = self._ns
                     with span(
                         "schedule-scenarios",
                         pods=len(run_pods), scenarios=s_real,
@@ -1443,7 +1483,7 @@ class Simulator:
                             vg_np,
                             dev_np,
                         ) = schedule_scenarios_host(
-                            self._ns, carry_s, batch,
+                            ns_sweep, carry_s, batch,
                             weights_s, valid_s, s_real,
                         )
                         sp.meta["scheduled"] = int((nodes_np >= 0).sum())
@@ -1654,9 +1694,11 @@ def batch_ineligible_reason(
     """Why this sweep cannot take the batched (vmapped) path, or None when it
     can. Every gate names a feature whose control flow is per-scenario serial
     (host round-trips per pod, node-set-dependent expansion/ordering) —
-    simulate_batch falls back to serial simulate() per scenario for these."""
-    if mesh is not None:
-        return "mesh sharding"
+    simulate_batch falls back to serial simulate() per scenario for these.
+
+    A mesh no longer gates: run_scenarios shards the scenario axis across
+    the mesh devices (parallel.mesh.scenario_mesh) — `mesh` stays in the
+    signature so callers probing eligibility need not special-case it."""
     if extenders:
         return "scheduler extenders"
     if profiles:
@@ -1743,8 +1785,8 @@ def simulate_batch(
     )
     if reason is None:
         results = Simulator(
-            cluster, weights=weights, use_greed=use_greed, n_pad=n_pad,
-            patch_pods=patch_pods, expand_cache=expand_cache,
+            cluster, weights=weights, use_greed=use_greed, mesh=mesh,
+            n_pad=n_pad, patch_pods=patch_pods, expand_cache=expand_cache,
             resident=resident,
         ).run_scenarios(apps, scenarios)
         if results is not None:

@@ -28,6 +28,7 @@ static guarantees as the scheduling kernels.
 
 from __future__ import annotations
 
+import functools
 from typing import Iterable
 
 import jax
@@ -58,19 +59,25 @@ def pad_indices(idx: Iterable[int], n: int) -> np.ndarray:
     return out
 
 
-@sanitizable("ops.delta:apply_rows")
-@jax.jit
+@sanitizable("ops.delta:apply_rows", donate_argnums=(0,))
+@functools.partial(jax.jit, donate_argnums=(0,))
 def apply_rows(arr: jnp.ndarray, idx: jnp.ndarray, rows: jnp.ndarray) -> jnp.ndarray:
     """Scatter whole re-encoded rows into a 2-D plane: arr[idx[u]] = rows[u].
     Out-of-range idx entries (the pad slots) are dropped, not clamped —
-    clamping would silently overwrite the last real row."""
+    clamping would silently overwrite the last real row.
+
+    `arr` is donated: the scatter lands in place instead of copying the
+    whole plane per delta. Callers must treat the passed plane as consumed
+    — ResidentCluster hands in a fresh copy whenever a table_view() loan of
+    the old plane may still be live (see engine/resident._apply_rows)."""
     return arr.at[idx].set(rows, mode="drop")
 
 
-@sanitizable("ops.delta:apply_flags")
-@jax.jit
+@sanitizable("ops.delta:apply_flags", donate_argnums=(0,))
+@functools.partial(jax.jit, donate_argnums=(0,))
 def apply_flags(arr: jnp.ndarray, idx: jnp.ndarray, vals: jnp.ndarray) -> jnp.ndarray:
-    """apply_rows for 1-D per-node vectors (unsched/valid flags, name ids)."""
+    """apply_rows for 1-D per-node vectors (unsched/valid flags, name ids).
+    Same donation contract as apply_rows: `arr` is consumed."""
     return arr.at[idx].set(vals, mode="drop")
 
 

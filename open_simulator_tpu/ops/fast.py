@@ -1780,8 +1780,8 @@ def reset_scenario_programs() -> None:
     _SCENARIO_PROGRAMS.clear()
 
 
-@sanitizable("ops.fast:schedule_scenarios")
-@jax.jit
+@sanitizable("ops.fast:schedule_scenarios", donate_argnums=(1,))
+@functools.partial(jax.jit, donate_argnums=(1,))
 def schedule_scenarios(
     ns: NodeStatic,
     carry_s: Carry,
@@ -1838,7 +1838,13 @@ def schedule_scenarios_host(
     numpy outputs trimmed to the `s_real` live scenarios. `carry_s` /
     `weights_s` / `valid_s` must already be padded to scenario_bucket(s_real)
     (pad lanes = copies of scenario 0); the returned carry keeps the padded
-    axis so it threads straight into the next call."""
+    axis so it threads straight into the next call.
+
+    The input `carry_s` is CONSUMED: schedule_scenarios donates it (the
+    stacked carry is the big resident tensor of a sweep, and XLA reuses its
+    buffers for the output carry). Callers must rebind — the stacked carry
+    from ops.state.stack_carry is freshly materialized per sweep, so the
+    simulator's own serial carry is never at risk."""
     rows = pod_rows_from_batch(batch)
     s_pad = int(valid_s.shape[0])
     key = (int(ns.valid.shape[0]), int(batch.p))

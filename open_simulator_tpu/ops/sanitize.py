@@ -81,6 +81,7 @@ def sanitizable(
     name: str,
     static_argnames: Sequence[str] = (),
     skip_kwargs: Sequence[str] = (),
+    donate_argnums: Sequence[int] = (),
 ) -> Callable:
     """Wrap a jitted entry point with an opt-in checkify layer.
 
@@ -93,9 +94,18 @@ def sanitizable(
     pallas_call's state effects (`JaxprInputEffect ... does not have
     corresponding input`), and the plain path already covers the shared
     math.
+
+    `donate_argnums` must repeat the underlying jit's donated positional
+    args. It is declarative: the jaxpr auditor reads it (as
+    ``__osim_donate_argnums__``) to prove no donated arg aliases another
+    arg of the same call, and callers/tests use it to know which inputs a
+    call consumes. The checkified re-jit deliberately does NOT donate —
+    sanitize mode trades the buffer reuse for intact inputs in checkify's
+    failure reports; results are bit-identical either way.
     """
     static = tuple(static_argnames)
     skips = tuple(skip_kwargs)
+    donated = tuple(donate_argnums)
 
     def deco(jitted: Callable) -> Callable:
         import inspect
@@ -154,6 +164,7 @@ def sanitizable(
         wrapper.trace = jitted.trace  # type: ignore[attr-defined]
         wrapper.lower = jitted.lower  # type: ignore[attr-defined]
         wrapper.__osim_sanitizable__ = name  # type: ignore[attr-defined]
+        wrapper.__osim_donate_argnums__ = donated  # type: ignore[attr-defined]
         return wrapper
 
     return deco

@@ -141,7 +141,14 @@ def stack_carry(carry: Carry, count: int) -> Carry:
     """Scenario-stacked Carry: every leaf gains a leading [S] axis holding
     `count` identical copies — the starting state of a multi-scenario sweep
     (all scenarios begin from the same cluster; their carries diverge as the
-    vmapped scan commits per-scenario placements)."""
+    vmapped scan commits per-scenario placements).
+
+    Donation-safe by construction: each eager broadcast_to materializes a
+    fresh dense [S, ...] buffer (XLA arrays have no stride-0 views), so the
+    stacked carry shares no buffer with `carry` and schedule_scenarios may
+    donate it while the source carry — possibly the simulator's live serial
+    carry or a resident device plane — stays untouched. tests/test_warmup.py
+    pins this contract."""
     import jax
 
     return jax.tree.map(
@@ -162,7 +169,14 @@ def align_carry_scenarios(
     """align_carry for a scenario-stacked carry ([S, rows, N] leaves): grows
     the selector/port/anti row axes (axis 1) in lockstep across all scenarios.
     Pass `ns` to also refresh NodeStatic.anti_topo, exactly as align_carry
-    does; returns (carry_s, ns) in that case."""
+    does; returns (carry_s, ns) in that case.
+
+    Donation note: when nothing grew the SAME carry object returns (identity
+    preserved for the caller's re-pin check); on growth the result still
+    shares its ungrown leaves with the input. Either way, handing the result
+    to the donating schedule_scenarios consumes the input carry_s too —
+    callers must rebind both names (run_scenarios threads one name through,
+    which does exactly that)."""
     PID, PIP = port_table_sizes(enc)
     new = {
         "sel_counts": _grow_rows_stacked(

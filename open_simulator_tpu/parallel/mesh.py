@@ -11,6 +11,13 @@ collectives).
 
 Pods are replicated (each step's pod features are tiny); the carry's free
 matrix is sharded with the nodes, and sel_counts shards along its node axis.
+
+The multi-scenario sweep shards the OTHER way: lanes of the vmapped commit
+engine (ops.fast.schedule_scenarios) are independent, so the scenario axis
+is embarrassingly parallel — `scenario_mesh` / `shard_scenarios` split the
+stacked carry, per-lane valid masks and weight rows across devices along
+axis 0 with the node tensors replicated, and each device runs its lanes
+with zero cross-device traffic until the host gathers results.
 """
 
 from __future__ import annotations
@@ -24,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops.kernels import Carry, NodeStatic, PodRow, schedule_step
 
 NODE_AXIS = "nodes"
+SCENARIO_AXIS = "scenarios"
 
 
 def make_mesh(devices: Optional[Sequence] = None) -> Mesh:
@@ -105,6 +113,37 @@ def shard_state(mesh: Mesh, ns: NodeStatic, carry: Carry):
     ns_sh = jax.device_put(ns, node_sharding(mesh))
     carry_sh = jax.device_put(carry, carry_sharding(mesh))
     return ns_sh, carry_sh
+
+
+def scenario_mesh(mesh: Mesh) -> Mesh:
+    """The same devices as `mesh`, re-axed for the multi-scenario sweep:
+    one 1-D axis named SCENARIO_AXIS. A separate Mesh object is required —
+    a jit call must see every committed input on ONE mesh, and the sweep's
+    lanes shard where the serial engine's nodes do."""
+    return Mesh(list(mesh.devices.flat), (SCENARIO_AXIS,))
+
+
+def shard_scenarios(
+    mesh: Mesh,
+    ns: NodeStatic,
+    carry_s: Carry,
+    valid_s: jnp.ndarray,
+    weights_s: jnp.ndarray,
+):
+    """device_put the stacked sweep state onto `mesh` (a scenario_mesh):
+    every [S, ...] tensor splits on its lane axis, the shared node tensors
+    replicate. Committed shardings make GSPMD compile schedule_scenarios
+    with the lane split for real (and the donated carry keeps it: donated
+    buffers alias outputs shard for shard). Callers must ensure S divides
+    the device count evenly — scenario_bucket pads S to a multiple of 8,
+    so 2/4/8-device meshes always divide; check before calling for other
+    shapes."""
+    lane = NamedSharding(mesh, P(SCENARIO_AXIS))
+    ns_sh = jax.device_put(ns, replicated(mesh, ns))
+    carry_sh = jax.device_put(carry_s, jax.tree.map(lambda _: lane, carry_s))
+    valid_sh = jax.device_put(valid_s, lane)
+    weights_sh = jax.device_put(weights_s, lane)
+    return ns_sh, carry_sh, valid_sh, weights_sh
 
 
 def sharded_schedule_batch(mesh: Mesh):

@@ -15,7 +15,8 @@
 # capture while the tunnel window is still fresh, with the mid rungs filled
 # in afterwards as evidence points.
 #
-# Usage: scripts/tpu_ladder2.sh    Results: /tmp/tpu_ladder2/, summary.log
+# Usage: scripts/tpu_ladder2.sh [--warmup]
+# Results: /tmp/tpu_ladder2/, summary.log
 set -u
 OUT=/tmp/tpu_ladder2
 mkdir -p "$OUT"
@@ -23,11 +24,21 @@ cd "$(dirname "$0")/.."
 SUMMARY="$OUT/summary.log"
 . scripts/tpu_lib.sh
 export OSIM_PROGRESS=1
+WARMUP=0
+for arg in "$@"; do
+    case "$arg" in
+        --warmup) WARMUP=1 ;;
+        *) echo "unknown arg: $arg (usage: $0 [--warmup])" >&2; exit 2 ;;
+    esac
+done
 
 # Run one bench segment (headline rung or named config) in a killable child.
 # Success = the child exited 0 AND printed a result JSON without an "error"
-# key: bench's _segment_main catches exceptions and exits 0 with
-# {"error": ...}, so the exit code alone cannot detect a half-wedged tunnel.
+# key AND — when the JSON stamps provenance — that provenance is not a CPU
+# run wearing the axon label: bench's _segment_main catches exceptions and
+# exits 0 with {"error": ...}, and a degraded backend still prints real
+# pods/s figures, so neither the exit code nor "did it print a number" can
+# detect a half-wedged tunnel or a silent CPU fallback.
 run_seg() { # run_seg name deadline segment [pods nodes]
     local name=$1 deadline=$2 seg=$3 pods=${4:-} nodes=${5:-}
     local args=(--segment "$seg")
@@ -37,7 +48,9 @@ run_seg() { # run_seg name deadline segment [pods nodes]
         python bench.py "${args[@]}" \
         > "$OUT/${name}.out" 2> "$OUT/${name}.err" \
         && grep -q '"wall_s"' "$OUT/${name}.out" \
-        && ! grep -q '"error"' "$OUT/${name}.out"; then
+        && ! grep -q '"error"' "$OUT/${name}.out" \
+        && ! grep -q '"fallback": "cpu"' "$OUT/${name}.out" \
+        && ! grep -q '"device": "[^"]*CPU' "$OUT/${name}.out"; then
         note "seg $name OK: $(tail -1 "$OUT/${name}.out" | cut -c1-200)"
         return 0
     fi
@@ -60,6 +73,22 @@ rung_with_retry() { # name deadline1 deadline2 pods nodes
 }
 
 wait_up 45 || { note "tunnel down at start"; exit 1; }
+
+if [ "$WARMUP" = 1 ]; then
+    # AOT-bank every audited jit entry + the sweep rehearsal into the
+    # persistent compile cache BEFORE any rung's deadline is running —
+    # compile time then never competes with a capture window. Best-effort:
+    # a failed warmup means the rungs pay their own compiles, as before.
+    note "warmup: simon warmup (AOT-compiling audited entries)"
+    if timeout 1200 env JAX_PLATFORMS=axon \
+        python -m open_simulator_tpu.cli.main warmup \
+        > "$OUT/warmup.out" 2> "$OUT/warmup.err"; then
+        note "warmup OK: $(grep '^warmup:' "$OUT/warmup.out" | cut -c1-200)"
+    else
+        note "warmup FAILED (rungs will compile cold): $(tail -1 "$OUT/warmup.err" | cut -c1-160)"
+        wait_up 45 || { note "tunnel never recovered after warmup"; exit 1; }
+    fi
+fi
 
 # Cache-resume sanity check: the 2k family compiled (74 s) earlier this
 # round. If this re-run's compile_s is seconds, axon executables persist

@@ -18,12 +18,42 @@ wait_up() { # wait_up [attempts=20]
     return 1
 }
 
-# If any of the given .out files carries a pods/s figure, chain into the
-# full round capture with the platform (and optional chunk) pinned.
+# Does any of the given .out files carry ON-DEVICE evidence? Parses the
+# last JSON line's TOP-LEVEL device/fallback fields (the honest-provenance
+# contract): a CPU-fallback rung still prints a pods/s figure, and nested
+# segment results (canary, headline_mid) carry their own device strings —
+# so neither `grep pods/s` nor a whole-file device grep is a device check
+# (the exact mislabel class ADVICE.md documents).
+seg_on_device() { # seg_on_device file...
+    local f
+    for f in "$@"; do
+        [ -s "$f" ] || continue
+        if tail -1 "$f" | python -c '
+import json, sys
+try:
+    d = json.loads(sys.stdin.read())
+except Exception:
+    sys.exit(1)
+ok = (
+    str(d.get("device", "")).startswith("TPU")
+    and d.get("fallback") != "cpu"
+    and "error" not in d
+)
+sys.exit(0 if ok else 1)
+'; then
+            return 0
+        fi
+    done
+    return 1
+}
+
+# If any of the given .out files carries an on-device pass (top-level
+# provenance, see seg_on_device), chain into the full round capture with
+# the platform (and optional chunk) pinned.
 # Returns 1 when nothing passed so callers can branch to a fallback.
 chain_capture_if_passed() { # chain_capture_if_passed chunk file...
     local chunk=$1; shift
-    if grep -q pods/s "$@" 2>/dev/null; then
+    if seg_on_device "$@"; then
         export JAX_PLATFORMS=axon
         [ -n "$chunk" ] && export OSIM_HEADLINE_CHUNK="$chunk"
         note "full headline passed — chaining into the round capture" \
