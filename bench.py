@@ -1017,6 +1017,215 @@ def config_serving_concurrent(
         srv.server_close()
 
 
+def config_serving_saturation(
+    n_clients=8, n_requests=12, queue_depth=16, n_nodes=10, replicas=12
+):
+    """Config 11: sustained serving throughput at queue saturation
+    (docs/serving.md "continuous batching"). M closed-loop clients — each
+    fires its next request the moment the previous answer lands — post
+    bodies that differ only in score weights, so every pack is a
+    multi-lane batched device call. n_clients defaults to one full
+    SCENARIO_BUCKET (8): the pack heuristic dispatches at a full bucket,
+    so the steady state is back-to-back full-occupancy device calls. The workload runs twice on the same
+    machine: once against the replaced architecture (coalesce-window
+    latency floor + cold per-pack dispatch: OSIM_SERVER_LOOP=0 and the
+    loop's legacy_floor switch) and once against the continuous-batching
+    loop (no floor, warm ScenarioSession packs). Reports sustained req/s
+    for both, lane occupancy mean, p50/p99, and the speedup; the
+    acceptance bar is speedup_x >= 2, and any non-200 response is an
+    error (closed-loop clients never overrun the queue, so zero shed)."""
+    import os
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from open_simulator_tpu.server import server as server_mod
+    from open_simulator_tpu.utils import metrics
+
+    def raw_node(name):
+        res = {"cpu": "32", "memory": "64Gi", "pods": "110"}
+        return {
+            "kind": "Node",
+            "metadata": {
+                "name": name, "labels": {"kubernetes.io/hostname": name},
+            },
+            "status": {"allocatable": dict(res), "capacity": dict(res)},
+        }
+
+    base_body = {
+        "cluster": {"objects": [raw_node(f"n-{i}") for i in range(n_nodes)]},
+        "apps": [
+            {
+                "name": "web",
+                "objects": [_mk_deploy("web", replicas, "500m", "1Gi")],
+            }
+        ],
+    }
+    bodies = [
+        json.dumps(
+            dict(base_body, weights={"least_allocated": 50 + i})
+        ).encode()
+        for i in range(n_clients)
+    ]
+
+    def run_mode(loop_on: bool) -> dict:
+        os.environ["OSIM_SERVER_LOOP"] = "1" if loop_on else "0"
+        with server_mod._sessions_lock:
+            server_mod._sessions.clear()
+        srv = server_mod.make_server(
+            0, queue_depth=queue_depth, pack_window_ms=50.0
+        )
+        if not loop_on:
+            # faithful baseline: the pre-loop worker waited the window out
+            # on EVERY batch, then dispatched cold
+            srv.admission._loop.legacy_floor = True
+        port = srv.server_address[1]
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        url = f"http://127.0.0.1:{port}/api/deploy-apps"
+
+        def one(payload, timeout=120.0):
+            req = urllib.request.Request(
+                url, data=payload,
+                headers={"Content-Type": "application/json"},
+            )
+            t0 = time.time()
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as r:
+                    r.read()
+                    return r.status, time.time() - t0
+            except urllib.error.HTTPError as e:
+                e.read()
+                return e.code, time.time() - t0
+            except Exception:
+                return -1, time.time() - t0
+
+        try:
+            # warm pass: compile the batched scenario program (full pack of
+            # n_clients lanes) before the timed run
+            warm_outcomes: list = []
+            warm_lock = threading.Lock()
+            warm_barrier = threading.Barrier(n_clients)
+
+            def warm_client(i):
+                warm_barrier.wait()
+                res = one(bodies[i])
+                with warm_lock:
+                    warm_outcomes.append(res)
+
+            threads = [
+                threading.Thread(target=warm_client, args=(i,))
+                for i in range(n_clients)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            bad = [c for c, _ in warm_outcomes if c != 200]
+            if bad:
+                return {"error": f"warm-up returned {sorted(set(bad))}"}
+            metrics.REGISTRY.reset()
+
+            outcomes: list = []
+            lock = threading.Lock()
+            barrier = threading.Barrier(n_clients)
+
+            def client(i):
+                barrier.wait()
+                mine = [one(bodies[i]) for _ in range(n_requests)]
+                with lock:
+                    outcomes.extend(mine)
+
+            threads = [
+                threading.Thread(target=client, args=(i,))
+                for i in range(n_clients)
+            ]
+            t0 = time.time()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.time() - t0
+
+            ok_lat = sorted(lat for code, lat in outcomes if code == 200)
+            bad_codes = sorted({c for c, _ in outcomes if c != 200})
+            _, occ_sum, occ_count = metrics.LANE_OCCUPANCY.child_state()
+            _, it_sum, it_count = metrics.LOOP_ITERATION.child_state()
+
+            def pct(p):
+                if not ok_lat:
+                    return None
+                return round(
+                    1000
+                    * ok_lat[min(len(ok_lat) - 1, int(p * len(ok_lat)))],
+                    1,
+                )
+
+            mode = {
+                "wall_s": round(wall, 2),
+                "req_s": (
+                    round(len(ok_lat) / wall, 1) if wall > 0 else 0.0
+                ),
+                "ok": len(ok_lat),
+                "requests": len(outcomes),
+                "p50_latency_ms": pct(0.50),
+                "p99_latency_ms": pct(0.99),
+                "lane_occupancy_mean": (
+                    round(occ_sum / occ_count, 3) if occ_count else None
+                ),
+                "loop_iterations": int(it_count),
+            }
+            if bad_codes:
+                mode["error"] = (
+                    f"non-200 response(s) at saturation: {bad_codes}"
+                )
+            return mode
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    prior = os.environ.get("OSIM_SERVER_LOOP")
+    try:
+        baseline = run_mode(loop_on=False)
+        loop = run_mode(loop_on=True)
+    finally:
+        if prior is None:
+            os.environ.pop("OSIM_SERVER_LOOP", None)
+        else:
+            os.environ["OSIM_SERVER_LOOP"] = prior
+        with server_mod._sessions_lock:
+            server_mod._sessions.clear()
+
+    for mode_name, mode in (("baseline", baseline), ("loop", loop)):
+        if mode.get("error"):
+            return {"error": f"{mode_name}: {mode['error']}"}
+    speedup = (
+        round(loop["req_s"] / baseline["req_s"], 2)
+        if baseline["req_s"]
+        else 0.0
+    )
+    out = {
+        "value": loop["req_s"],
+        "unit": "req/s",
+        "wall_s": round(baseline["wall_s"] + loop["wall_s"], 2),
+        "clients": n_clients,
+        "requests_per_client": n_requests,
+        "queue_depth": queue_depth,
+        "baseline_req_s": baseline["req_s"],
+        "speedup_x": speedup,
+        "p50_latency_ms": loop["p50_latency_ms"],
+        "p99_latency_ms": loop["p99_latency_ms"],
+        "lane_occupancy_mean": loop["lane_occupancy_mean"],
+        "baseline": baseline,
+        "loop": loop,
+    }
+    if speedup < 2.0:
+        out["error"] = (
+            f"continuous-batching speedup {speedup}x is below the 2x "
+            f"acceptance bar ({loop['req_s']} vs {baseline['req_s']} req/s)"
+        )
+    return out
+
+
 def config_resident_delta_10k(n_nodes=10_000, n_deltas=30, touched=8):
     """Config 10: the resident-state delta path (engine/resident.py) at 10k
     nodes. A ResidentCluster cold-encodes once, then absorbs `n_deltas`
@@ -1135,6 +1344,7 @@ CONFIGS = {
     "preempt_tiered": config_preempt,
     "extender_1k": config_extender,
     "serving_concurrent": config_serving_concurrent,
+    "serving_saturation": config_serving_saturation,
     "resident_delta_10k": config_resident_delta_10k,
 }
 
@@ -1253,6 +1463,7 @@ SEGMENT_TIMEOUT_S = {
     "preempt_tiered": 900.0,
     "extender_1k": 900.0,
     "serving_concurrent": 600.0,
+    "serving_saturation": 900.0,
     "resident_delta_10k": 900.0,
 }
 

@@ -778,9 +778,17 @@ def main(argv=None) -> int:
         "(default: OSIM_SERVER_QUEUE_DEPTH or 16; docs/serving.md)",
     )
     ps.add_argument(
+        "--pack-window-ms", type=float, default=None,
+        help="upper bound on how long the scheduler loop holds a PARTIAL "
+        "pack open for stragglers; lone requests and full packs always "
+        "dispatch immediately (default: OSIM_SERVER_PACK_WINDOW_MS or 0)",
+    )
+    ps.add_argument(
         "--coalesce-ms", type=float, default=None,
-        help="micro-batching window for identical concurrent requests "
-        "(default: OSIM_SERVER_COALESCE_MS or 0 = off)",
+        help="DEPRECATED alias for --pack-window-ms (the fixed coalescing "
+        "window became the pack-window upper bound of the continuous-"
+        "batching loop; OSIM_SERVER_COALESCE_MS still works, with a "
+        "warning — see docs/serving.md migration note)",
     )
     ps.add_argument(
         "--default-deadline-ms", type=float, default=None,
@@ -844,6 +852,7 @@ def main(argv=None) -> int:
             master=args.master,
             queue_depth=args.queue_depth,
             coalesce_ms=args.coalesce_ms,
+            pack_window_ms=args.pack_window_ms,
             default_deadline_ms=args.default_deadline_ms,
         )
     if args.command == "apply":

@@ -192,10 +192,13 @@ class ResidentCluster:
     # -- public surface ----------------------------------------------------
 
     def fence_epoch(self) -> int:
-        """The epoch a request must record at admission. The stale_generation
-        chaos kind returns a sentinel that can never match a live epoch, so
-        the dequeue-side fence re-keys the ticket (the degraded outcome is a
-        private coalesce key — never a cross-generation merge)."""
+        """The epoch a request must record at admission. The continuous-
+        batching scheduler loop (server/loop.py) consults this ONCE PER PACK
+        at pack-take time and re-keys every ticket whose admission-time epoch
+        moved — so all lanes of one batched device call see the same resident
+        state. The stale_generation chaos kind returns a sentinel that can
+        never match a live epoch, forcing the re-key (the degraded outcome is
+        a private coalesce key — never a cross-generation merge)."""
         rule = faults.maybe_inject("resident", "fence")
         if rule is not None and rule.kind == "stale_generation":
             return -1

@@ -1759,8 +1759,13 @@ def schedule_batch_fast(
 SCENARIO_BUCKET = 8
 
 
-def scenario_bucket(s: int) -> int:
-    return round_up(max(int(s), 1), SCENARIO_BUCKET)
+def scenario_bucket(s: int, floor: int = 0) -> int:
+    """Padded scenario count for `s` real lanes. `floor` (itself a padded
+    count) keeps a warm shape warm across consecutive serving packs: a
+    3-lane pack following an 8-lane pack pads back to 8 and reuses the
+    compiled program instead of tracing a 8-vs-smaller shape pair (the
+    continuous-batching loop passes the previous pack's pad here)."""
+    return round_up(max(int(s), 1, int(floor)), SCENARIO_BUCKET)
 
 
 # (N, P) shape key -> set of padded scenario counts seen: each distinct entry

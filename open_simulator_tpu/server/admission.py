@@ -24,13 +24,17 @@ decision; this module is that front door:
   deadline can abort a wedged simulate mid-flight (504) instead of
   letting the client hang.
 
-* **Coalescing window** — requests arriving within
-  `OSIM_SERVER_COALESCE_MS` of the batch head are drained together;
-  requests with the same coalesce key (body digest + snapshot
-  generation) run as ONE entry in the batch executor and the result is
-  fanned back out to every waiter. The batch executor
-  (`execute(bodies) -> results`) is the seam the vmapped multi-scenario
-  engine (ROADMAP item 1) will slot into; today it loops.
+* **Continuous-batching pack** — the queue is drained by the persistent
+  scheduler loop (`server/loop.py`): between consecutive device calls,
+  whatever compatible tickets are queued are packed into the next
+  scenario-batched call. The old fixed coalescing window survives only
+  as the *pack window* — an upper bound on how long a partial pack may
+  wait for stragglers, never a latency floor (a lone ticket dispatches
+  immediately). `OSIM_SERVER_PACK_WINDOW_MS` names it; the legacy
+  `OSIM_SERVER_COALESCE_MS` still works as a deprecated alias. Tickets
+  with the same coalesce key (body digest + snapshot generation) run as
+  ONE entry in the batch executor and the result is fanned back out to
+  every waiter.
 
 * **Shed accounting** — `osim_requests_shed_total{reason=queue_full|
   deadline|draining}`, `osim_admission_queue_depth`,
@@ -66,7 +70,15 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
-from ..durable.watchdog import DeadlineExceeded, call_deadline_s, guarded_call
+# Re-exported for the scheduler loop (server/loop.py), which resolves
+# guarded_call / call_deadline_s / DeadlineExceeded through THIS module's
+# namespace so tests monkeypatching `admission.guarded_call` keep
+# intercepting the device call.
+from ..durable.watchdog import (  # noqa: F401
+    DeadlineExceeded,
+    call_deadline_s,
+    guarded_call,
+)
 from ..resilience import faults
 from ..utils import metrics
 from ..utils.tracing import log
@@ -76,7 +88,33 @@ from ..utils.tracing import log
 DEFAULT_QUEUE_DEPTH = 16
 DEFAULT_COALESCE_MS = 0.0
 DEFAULT_DEADLINE_MS = 0.0
+#: Retry-After fallback for the zero-sample cold start: before the loop
+#: has completed a single iteration there is no observed service time, so
+#: the hint is this flat constant WITHOUT backlog scaling (the old code
+#: multiplied a made-up 1 s by the backlog, telling the first burst's
+#: clients to back off for the full queue depth before anything had run).
 DEFAULT_SERVICE_TIME_S = 1.0
+
+# One-time deprecation warning for OSIM_SERVER_COALESCE_MS (kept working
+# as the pack-window upper bound; see SchedulerLoop). The flag is read and
+# set under the lock because queues are constructed from handler-bearing
+# modules.
+_deprecation_lock = threading.Lock()
+_coalesce_ms_warned = False
+
+
+def _warn_coalesce_deprecated() -> None:
+    global _coalesce_ms_warned
+    with _deprecation_lock:
+        if _coalesce_ms_warned:
+            return
+        _coalesce_ms_warned = True
+    log.warning(
+        "OSIM_SERVER_COALESCE_MS is deprecated: the coalesce window became "
+        "the continuous-batching pack window (an upper bound, not a latency "
+        "floor). Set OSIM_SERVER_PACK_WINDOW_MS instead; the old variable "
+        "keeps working with identical units (docs/serving.md)."
+    )
 
 REASON_QUEUE_FULL = "queue_full"
 REASON_DEADLINE = "deadline"
@@ -139,7 +177,7 @@ class Ticket:
     enqueued_at: float
     deadline_at: Optional[float] = None  # absolute, clock() domain
     # live-snapshot generation recorded at admission; None = not fenced.
-    # _run_batch re-keys the ticket if the queue's fence moved past it.
+    # the loop re-keys the ticket at pack time if the fence moved past it.
     fence_epoch: Optional[int] = None
     done: threading.Event = field(default_factory=threading.Event)
     # response (valid once done is set)
@@ -155,19 +193,29 @@ class Ticket:
 
 
 class AdmissionQueue:
-    """Bounded admission queue drained by one scheduler worker thread.
+    """Bounded admission queue drained by the continuous-batching scheduler
+    loop (server/loop.py).
 
-    `execute` is the batch executor: it receives the drained batch's
-    UNIQUE bodies (one per coalesce key, in arrival order) and returns one
-    result per body — a payload dict, or an Exception instance for a
-    per-body failure. All other parameters default from the environment at
+    `execute` is the batch executor: it receives the pack's UNIQUE bodies
+    (one per coalesce key, in arrival order) and returns one result per
+    body — a payload dict, or an Exception instance for a per-body
+    failure. All other parameters default from the environment at
     construction time (never import time):
 
         OSIM_SERVER_QUEUE_DEPTH         max queued requests (beyond the
-                                        batch being executed)
-        OSIM_SERVER_COALESCE_MS         micro-batching window; 0 disables
+                                        pack being executed)
+        OSIM_SERVER_PACK_WINDOW_MS      upper bound on how long a PARTIAL
+                                        pack waits for stragglers; 0
+                                        disables (never a latency floor)
+        OSIM_SERVER_COALESCE_MS         deprecated alias of the pack
+                                        window (same units; warns once)
         OSIM_SERVER_DEFAULT_DEADLINE_MS deadline for requests that carry
                                         no X-Osim-Deadline-Ms; 0 = none
+
+    `service_time_s` seeds the loop-iteration EWMA behind Retry-After;
+    None (the default) starts with zero samples — sheds before the first
+    completed iteration answer a flat DEFAULT_SERVICE_TIME_S hint instead
+    of a backlog multiple of a constant nobody measured.
 
     `clock` and `watchdog_poll_s` are injectable so tests prove deadline
     and shed behavior without sleeping.
@@ -179,16 +227,19 @@ class AdmissionQueue:
         *,
         depth: Optional[int] = None,
         coalesce_ms: Optional[float] = None,
+        pack_window_ms: Optional[float] = None,
+        pack_lanes: Optional[int] = None,
         default_deadline_ms: Optional[float] = None,
         clock: Callable[[], float] = time.monotonic,
-        service_time_s: float = DEFAULT_SERVICE_TIME_S,
+        service_time_s: Optional[float] = None,
         watchdog_poll_s: float = 0.25,
         fence: Optional[Callable[[], int]] = None,
     ) -> None:
         self._execute = execute
-        # Generation fence (engine/resident.py): called once per batch at
-        # dequeue; fenced tickets whose recorded epoch differs are re-keyed
-        # so they can only coalesce with same-state work (docs/serving.md).
+        # Generation fence (engine/resident.py): called once per PACK at
+        # pack-take time; fenced tickets whose recorded epoch differs are
+        # re-keyed so they only coalesce with same-state work
+        # (docs/serving.md).
         self._fence = fence
         self.depth = (
             depth
@@ -197,11 +248,26 @@ class AdmissionQueue:
         )
         if self.depth < 1:
             raise ValueError(f"queue depth must be >= 1, got {self.depth}")
-        self.coalesce_s = (
-            coalesce_ms
-            if coalesce_ms is not None
-            else _env_float("OSIM_SERVER_COALESCE_MS", DEFAULT_COALESCE_MS)
-        ) / 1000.0
+        # Pack window resolution: explicit pack_window_ms wins, then the
+        # legacy coalesce_ms parameter, then OSIM_SERVER_PACK_WINDOW_MS,
+        # then the deprecated OSIM_SERVER_COALESCE_MS (with a one-time
+        # warning). The attribute keeps its historical name — it is public
+        # API for tests and the server.
+        if pack_window_ms is not None:
+            window_ms = float(pack_window_ms)
+        elif coalesce_ms is not None:
+            window_ms = float(coalesce_ms)
+        elif os.environ.get("OSIM_SERVER_PACK_WINDOW_MS", "").strip():
+            window_ms = _env_float(
+                "OSIM_SERVER_PACK_WINDOW_MS", DEFAULT_COALESCE_MS
+            )
+        else:
+            if os.environ.get("OSIM_SERVER_COALESCE_MS", "").strip():
+                _warn_coalesce_deprecated()
+            window_ms = _env_float(
+                "OSIM_SERVER_COALESCE_MS", DEFAULT_COALESCE_MS
+            )
+        self.coalesce_s = window_ms / 1000.0
         self.default_deadline_ms = (
             default_deadline_ms
             if default_deadline_ms is not None
@@ -212,14 +278,22 @@ class AdmissionQueue:
         self._cv = threading.Condition()
         self._queue: List[Ticket] = []
         self._draining = False
-        self._service_time_s = max(float(service_time_s), 0.001)
+        # Loop-iteration EWMA (seconds per iteration); None = no samples.
+        self._service_time_s: Optional[float] = (
+            max(float(service_time_s), 0.001)
+            if service_time_s is not None
+            else None
+        )
         self._worker: Optional[threading.Thread] = None
+        from .loop import SchedulerLoop  # local: loop.py imports this module
+
+        self._loop = SchedulerLoop(self, pack_lanes=pack_lanes)
 
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> "AdmissionQueue":
         self._worker = threading.Thread(
-            target=self._worker_loop, name="osim-admission-worker", daemon=True
+            target=self._worker_main, name="osim-scheduler-loop", daemon=True
         )
         self._worker.start()
         return self
@@ -299,17 +373,24 @@ class AdmissionQueue:
                 break
         return ticket
 
-    # -- the scheduler worker -----------------------------------------------
+    # -- the scheduler-loop thread ------------------------------------------
 
-    def _worker_loop(self) -> None:
+    def worker_alive(self) -> bool:
+        """Whether the scheduler-loop thread is running. The HTTP layer
+        consults this before submit to take the per-request degradation
+        path (docs/serving.md) instead of queueing behind a dead loop."""
+        w = self._worker
+        return w is not None and w.is_alive()
+
+    def _worker_main(self) -> None:
+        """Thread body: the continuous-batching loop (server/loop.py) plus
+        crash containment — a dying loop drains every queued ticket as
+        dropped (counted; the one unacceptable outcome) instead of leaving
+        waiters hanging."""
         try:
-            while True:
-                batch = self._collect_batch()
-                if batch is None:
-                    return
-                self._run_batch(batch)
-        except BaseException:  # pragma: no cover - worker must never die silently
-            log.exception("admission worker crashed; draining queue as dropped")
+            self._loop.run_forever()
+        except BaseException:  # pragma: no cover - loop must never die silently
+            log.exception("scheduler loop crashed; draining queue as dropped")
             with self._cv:
                 for t in self._queue:
                     self._drop(t)
@@ -317,30 +398,9 @@ class AdmissionQueue:
                 metrics.ADMISSION_QUEUE_DEPTH.set(0)
             raise
 
-    def _collect_batch(self) -> Optional[List[Ticket]]:
-        """Wait for work, hold the coalescing window open, then take the
-        whole backlog as one batch. Returns None when drained out."""
-        with self._cv:
-            while not self._queue and not self._draining:
-                self._cv.wait()
-            if not self._queue:  # draining and empty
-                return None
-            if self.coalesce_s > 0:
-                head = self._queue[0]
-                window_end = head.enqueued_at + self.coalesce_s
-                while not self._draining:
-                    remaining = window_end - self._clock()
-                    if remaining <= 0 or len(self._queue) >= self.depth:
-                        break
-                    self._cv.wait(remaining)
-            batch = list(self._queue)
-            self._queue.clear()
-            metrics.ADMISSION_QUEUE_DEPTH.set(0)
-            return batch or None
-
     def run_pending(self) -> int:
         """Test/embedding hook: synchronously process everything queued NOW
-        (no window waiting, no worker thread). Returns batches processed."""
+        (no window waiting, no loop thread). Returns packs processed."""
         n = 0
         while True:
             with self._cv:
@@ -349,113 +409,40 @@ class AdmissionQueue:
                 metrics.ADMISSION_QUEUE_DEPTH.set(0)
             if not batch:
                 return n
-            self._run_batch(batch)
+            self._loop.run_pack(batch)
             n += 1
 
-    def _run_batch(self, batch: List[Ticket]) -> None:
-        now = self._clock()
-        # 1. deadline sheds AT DEQUEUE: expired requests never reach execute
-        live: List[Ticket] = []
-        for t in batch:
-            if t.deadline_at is not None and now >= t.deadline_at:
-                self._shed(t, REASON_DEADLINE)
-            else:
-                live.append(t)
-        if not live:
-            return
-        # 2. generation fence AT DEQUEUE: a fenced ticket admitted under
-        #    epoch E whose snapshot moved to E' before this batch drained is
-        #    re-keyed onto E' — it will be served against the E' state, and
-        #    must only coalesce with other E' work. Without this, a ticket
-        #    keyed "...:genE" could fan out one result to waiters that were
-        #    admitted across a state change (the stale_generation chaos kind
-        #    forces the mismatch by returning a sentinel epoch).
-        if self._fence is not None and any(t.fence_epoch is not None for t in live):
-            current = self._fence()
-            for t in live:
-                if t.fence_epoch is None:
-                    continue
-                if t.fence_epoch == current:
-                    metrics.ADMISSION_FENCE.inc(outcome="current")
-                else:
-                    t.key += f"@fence{current}"
-                    t.fence_epoch = current
-                    metrics.ADMISSION_FENCE.inc(outcome="rekeyed")
-        # 3. injected slow drain (models a wedged backend eating the window)
-        rule = faults.maybe_inject("admission", "drain")
-        if rule is not None and rule.kind == "slow_drain" and rule.latency_s > 0:
-            time.sleep(rule.latency_s)
-        # 4. coalesce: one executor entry per distinct key, arrival order
-        groups: Dict[str, List[Ticket]] = {}
-        order: List[str] = []
-        for t in live:
-            if t.key not in groups:
-                groups[t.key] = []
-                order.append(t.key)
-            groups[t.key].append(t)
-        bodies = [groups[k][0].body for k in order]
-        # 5. watchdog budget: the most generous live deadline (a stricter
-        #    per-request budget would abort shared work other waiters still
-        #    have time for); deadline-less waiters fall back to the global
-        #    OSIM_CALL_DEADLINE_S (0 = unguarded).
-        budgets = [t.remaining_s(now) for t in live]
-        budget = call_deadline_s() if any(b is None for b in budgets) else max(budgets)
-        t0 = self._clock()
-        try:
-            results = guarded_call(
-                "serve-simulate",
-                lambda: self._execute(bodies),
-                budget if budget and budget > 0 else 0.0,
-                clock=self._clock,
-                poll_s=self._poll_s,
-            )
-            if len(results) != len(bodies):
-                raise RuntimeError(
-                    f"batch executor returned {len(results)} results "
-                    f"for {len(bodies)} bodies"
-                )
-        except DeadlineExceeded as e:
-            for t in live:
-                self._finalize(t, 504, {"error": str(e)})
-            return
-        except Exception as e:  # executor-level failure: every waiter gets a 400
-            for t in live:
-                self._finalize(t, 400, {"error": str(e)})
-            return
-        elapsed = max(self._clock() - t0, 0.0)
-        # EWMA of per-entry service time feeds Retry-After on future sheds
-        per_entry = elapsed / len(bodies)
+    def _note_iteration(self, elapsed: float) -> None:
+        """Fold one observed loop-iteration duration into the Retry-After
+        EWMA. Called by the loop for EVERY iteration (even all-shed ones):
+        the hint must track what an iteration costs under current load."""
+        metrics.LOOP_ITERATION.observe(elapsed)
         with self._cv:
-            self._service_time_s = max(
-                0.3 * per_entry + 0.7 * self._service_time_s, 0.001
-            )
-        # 6. fan each group's one result back out to all of its waiters
-        for k, res in zip(order, results):
-            waiters = groups[k]
-            # mode="fanout": N identical requests served by ONE result.
-            # (mode="scenarios" — distinct bodies merged into one batched
-            # device call — is observed by the executor, which is the layer
-            # that knows the scenario grouping; see server._execute_bodies.)
-            metrics.COALESCED_BATCH.observe(len(waiters), mode="fanout")
-            for t in waiters:
-                if isinstance(res, BaseException):
-                    self._finalize(t, 400, {"error": str(res)})
-                else:
-                    self._finalize(t, 200, res)
+            if self._service_time_s is None:
+                self._service_time_s = max(elapsed, 0.001)
+            else:
+                self._service_time_s = max(
+                    0.3 * elapsed + 0.7 * self._service_time_s, 0.001
+                )
 
     # -- finalization -------------------------------------------------------
 
+    def _retry_hint_locked(self) -> int:
+        """Honest backoff hint (seconds, >= 1): observed loop-iteration
+        EWMA x backlog — with continuous batching the backlog drains pack
+        by pack, so iterations-to-drain scales with how many tickets sit
+        ahead. Zero-sample cold start (no iteration observed yet) answers
+        the flat DEFAULT_SERVICE_TIME_S instead of backlog x guess."""
+        if self._service_time_s is None:
+            return max(1, int(math.ceil(DEFAULT_SERVICE_TIME_S)))
+        backlog = len(self._queue) + 1
+        return max(1, int(math.ceil(self._service_time_s * backlog)))
+
     def retry_after_s(self) -> int:
-        """Honest backoff hint: the backlog's expected drain time under the
-        observed per-request service time, floored at 1 s."""
         with self._cv:
-            backlog = len(self._queue) + 1
-            est = self._service_time_s * backlog
-        return max(1, int(math.ceil(est)))
+            return self._retry_hint_locked()
 
     def _shed_locked(self, ticket: Ticket, reason: str) -> None:
-        backlog = len(self._queue) + 1
-        est = self._service_time_s * backlog
         self._finalize(
             ticket,
             _SHED_CODE[reason],
@@ -463,7 +450,7 @@ class AdmissionQueue:
                 "error": f"request shed: {reason.replace('_', ' ')}",
                 "reason": reason,
             },
-            headers={"Retry-After": str(max(1, int(math.ceil(est))))},
+            headers={"Retry-After": str(self._retry_hint_locked())},
             shed_reason=reason,
         )
 

@@ -1,0 +1,289 @@
+"""Continuous-batching scheduler loop: one always-hot device loop.
+
+PR 6 gave serving a bounded admission queue drained by a worker that held
+a fixed `OSIM_SERVER_COALESCE_MS` window open, then dispatched one cold
+batch end-to-end — every request paid the window as a latency floor, and
+requests arriving while a batch executed queued behind the *next* window
+too. This module replaces that drain policy with the architecture LLM
+inference serving converged on (continuous batching of sequences):
+
+* a **persistent scheduler loop** owns the device; between consecutive
+  device calls it packs whatever compatible tickets are queued into the
+  next scenario-batched call — lanes join and leave between calls;
+* the coalesce window shrank to a **pack heuristic**: a lone ticket on
+  an idle server dispatches immediately (no mandatory wait — the p50 of
+  an idle server is one device call), a full pack (>= pack_lanes or
+  queue depth) dispatches immediately, and a *partial* pack — or a lone
+  ticket arriving right behind a multi-lane pack, i.e. the head of a
+  re-posting herd — holds the window open, bounded by `pack_window_s`,
+  hoping stragglers fill the SCENARIO_BUCKET before the next call;
+* the generation fence (engine/resident.py) is consulted **once per
+  pack** at pack-take time, so a ticket can only coalesce with work that
+  will run against the same cluster epoch it will actually see.
+
+The split of responsibilities: `AdmissionQueue` (admission.py) keeps the
+ticket lifecycle — submit/shed/wait/finalize and the Retry-After
+accounting — while this loop owns *when the device runs and with what
+pack*. The loop deliberately reaches into the queue's internals
+(`_cv`/`_queue`/`_shed`/`_finalize`); they are two halves of one
+scheduler separated so each half stays testable sleep-free.
+
+Observability: `osim_loop_iteration_seconds` (one full iteration:
+deadline sheds + fence + device call + fan-out; its EWMA feeds
+Retry-After), `osim_pack_latency_seconds` (per-ticket admission->pack
+time — the queueing cost of continuous batching), and the engine-side
+`osim_lane_occupancy_ratio` (how full the padded scenario shape ran).
+
+Fault injection and the watchdog budget semantics are unchanged from the
+window era (docs/serving.md, docs/resilience.md); `guarded_call` /
+`call_deadline_s` / `DeadlineExceeded` are resolved through the
+admission module namespace so tests that monkeypatch
+`admission.guarded_call` keep intercepting the device call.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ..resilience import faults
+from ..utils import metrics
+from . import admission as admission_mod
+
+
+def default_pack_lanes() -> int:
+    """Target lanes per pack: one SCENARIO_BUCKET, so a full pack exactly
+    fills the padded scenario shape the compiled program already has warm.
+    Falls back to 8 (the bucket's value) if the ops layer is unavailable —
+    the heuristic must not make admission import the device stack."""
+    try:
+        from ..ops.fast import SCENARIO_BUCKET
+
+        return int(SCENARIO_BUCKET)
+    except Exception:  # pragma: no cover - ops always importable in-tree
+        return 8
+
+
+def pack_ready(
+    n_queued: int, *, depth: int, pack_lanes: int, saturated: bool = False
+) -> bool:
+    """Dispatch-now predicate of the pack heuristic. True when waiting any
+    longer cannot improve the pack:
+
+    * a lone ticket on an IDLE server — no latency floor; the p50 of a
+      lone request is one device call, exactly like serial simulate();
+    * a full pack — `pack_lanes` (one scenario bucket) or the queue depth
+      reached, whichever is smaller: more waiting cannot add lanes worth
+      padding for.
+
+    Anything in between is a *partial* pack: the loop may hold the window
+    open (bounded by pack_window_s) for stragglers to join.
+
+    `saturated` is the loop's recent-load signal: the previous pack was
+    multi-lane and just finished. Under saturation a lone ticket is
+    almost always the FIRST straggler of a thundering herd — the waiters
+    of the pack that just fanned out are re-posting — so dispatching it
+    alone would burn a full device call on one lane while the rest of
+    the herd queues behind it. Treat it as a partial pack instead and
+    let the window (an upper bound, not a floor) collect the herd."""
+    if n_queued <= 0:
+        return False
+    if n_queued == 1:
+        return not saturated
+    return n_queued >= min(pack_lanes, depth)
+
+
+class SchedulerLoop:
+    """The always-hot half of the serving scheduler: take_pack() decides
+    *when* the device runs, run_pack() is one loop iteration (deadline
+    sheds -> per-pack fence re-key -> coalesce -> guarded device call ->
+    fan-out). Constructed by AdmissionQueue; `queue` is the ticket store."""
+
+    def __init__(
+        self,
+        queue,
+        *,
+        pack_lanes: Optional[int] = None,
+        pack_window_s: Optional[float] = None,
+    ) -> None:
+        self.queue = queue
+        self.pack_lanes = (
+            int(pack_lanes) if pack_lanes is not None else default_pack_lanes()
+        )
+        # The window is an UPPER BOUND on how long a partial pack may wait,
+        # not a floor; defaults to the queue's configured window (the
+        # OSIM_SERVER_COALESCE_MS deprecation shim resolves into it).
+        self.pack_window_s = (
+            float(pack_window_s)
+            if pack_window_s is not None
+            else queue.coalesce_s
+        )
+        # Saturation signal for pack_ready's lone-ticket case: lane count
+        # and completion time of the previous pack. A lone arrival within
+        # one pack window of a multi-lane pack finishing is the head of a
+        # re-posting herd, not an idle-server request.
+        self._last_pack_lanes = 0
+        self._last_pack_end: Optional[float] = None
+        # Bench-only switch (bench.py serving_saturation): when True the
+        # window reverts to the PRE-loop semantics — a latency floor every
+        # pack waits out, pack_ready ignored — so the replaced coalesce-
+        # window-then-cold-dispatch architecture can be measured as the
+        # baseline of the continuous-batching speedup claim. Never set in
+        # production paths.
+        self.legacy_floor = False
+
+    # -- loop driver --------------------------------------------------------
+
+    def run_forever(self) -> None:
+        """Body of the scheduler-loop thread: pack, run, repeat, until the
+        queue drains out (shutdown). Crash containment lives in the
+        queue's thread wrapper (_worker_main), not here."""
+        while True:
+            pack = self.take_pack()
+            if pack is None:
+                return
+            self.run_pack(pack)
+
+    def take_pack(self) -> Optional[List]:
+        """Block until work exists, apply the pack heuristic, then take the
+        whole backlog as the next pack. Returns None when draining and
+        empty (loop exit)."""
+        q = self.queue
+        with q._cv:
+            while not q._queue and not q._draining:
+                q._cv.wait()
+            if not q._queue:  # draining and empty
+                return None
+            if self.pack_window_s > 0:
+                head = q._queue[0]
+                window_end = head.enqueued_at + self.pack_window_s
+                while not q._draining:
+                    saturated = (
+                        self._last_pack_lanes > 1
+                        and self._last_pack_end is not None
+                        and q._clock() - self._last_pack_end
+                        < self.pack_window_s
+                    )
+                    if not self.legacy_floor and pack_ready(
+                        len(q._queue), depth=q.depth,
+                        pack_lanes=self.pack_lanes, saturated=saturated,
+                    ):
+                        break
+                    remaining = window_end - q._clock()
+                    if remaining <= 0:
+                        break
+                    q._cv.wait(remaining)
+            pack = list(q._queue)
+            q._queue.clear()
+            metrics.ADMISSION_QUEUE_DEPTH.set(0)
+            return pack or None
+
+    # -- one loop iteration -------------------------------------------------
+
+    def run_pack(self, pack: List) -> None:
+        """One iteration of the hot loop over one pack of tickets. Always
+        observes osim_loop_iteration_seconds and feeds the iteration-time
+        EWMA, even when every ticket sheds — Retry-After must track what
+        an iteration actually costs under the current load."""
+        q = self.queue
+        t_iter = q._clock()
+        now = t_iter
+        for t in pack:
+            metrics.PACK_LATENCY.observe(max(now - t.enqueued_at, 0.0))
+        try:
+            self._run_pack_inner(pack, now)
+        finally:
+            self._last_pack_lanes = len(pack)
+            self._last_pack_end = q._clock()
+            q._note_iteration(max(q._clock() - t_iter, 0.0))
+
+    def _run_pack_inner(self, pack: List, now: float) -> None:
+        q = self.queue
+        # 1. deadline sheds AT PACK TIME: expired tickets never reach the
+        #    device call (the deadline_storm chaos kind relies on this).
+        live: List = []
+        for t in pack:
+            if t.deadline_at is not None and now >= t.deadline_at:
+                q._shed(t, admission_mod.REASON_DEADLINE)
+            else:
+                live.append(t)
+        if not live:
+            return
+        # 2. generation fence PER PACK: a fenced ticket admitted under epoch
+        #    E whose snapshot moved to E' before this pack was taken is
+        #    re-keyed onto E' — it runs against the E' state and must only
+        #    coalesce with other E' work. One fence() call covers the whole
+        #    pack: every lane of the coming device call sees the same
+        #    resident state (the stale_generation chaos kind forces the
+        #    mismatch with a sentinel epoch).
+        if q._fence is not None and any(
+            t.fence_epoch is not None for t in live
+        ):
+            current = q._fence()
+            for t in live:
+                if t.fence_epoch is None:
+                    continue
+                if t.fence_epoch == current:
+                    metrics.ADMISSION_FENCE.inc(outcome="current")
+                else:
+                    t.key += f"@fence{current}"
+                    t.fence_epoch = current
+                    metrics.ADMISSION_FENCE.inc(outcome="rekeyed")
+        # 3. injected slow drain (models a wedged backend eating the pack)
+        rule = faults.maybe_inject("admission", "drain")
+        if rule is not None and rule.kind == "slow_drain" and rule.latency_s > 0:
+            time.sleep(rule.latency_s)
+        # 4. coalesce: one executor entry per distinct key, arrival order
+        groups: Dict[str, List] = {}
+        order: List[str] = []
+        for t in live:
+            if t.key not in groups:
+                groups[t.key] = []
+                order.append(t.key)
+            groups[t.key].append(t)
+        bodies = [groups[k][0].body for k in order]
+        # 5. watchdog budget: the most generous live deadline (a stricter
+        #    per-request budget would abort shared work other waiters still
+        #    have time for); deadline-less waiters fall back to the global
+        #    OSIM_CALL_DEADLINE_S (0 = unguarded). Resolved through the
+        #    admission module so monkeypatched guarded_call intercepts.
+        budgets = [t.remaining_s(now) for t in live]
+        budget = (
+            admission_mod.call_deadline_s()
+            if any(b is None for b in budgets)
+            else max(budgets)
+        )
+        try:
+            results = admission_mod.guarded_call(
+                "serve-simulate",
+                lambda: q._execute(bodies),
+                budget if budget and budget > 0 else 0.0,
+                clock=q._clock,
+                poll_s=q._poll_s,
+            )
+            if len(results) != len(bodies):
+                raise RuntimeError(
+                    f"batch executor returned {len(results)} results "
+                    f"for {len(bodies)} bodies"
+                )
+        except admission_mod.DeadlineExceeded as e:
+            for t in live:
+                q._finalize(t, 504, {"error": str(e)})
+            return
+        except Exception as e:  # executor failure: every waiter gets a 400
+            for t in live:
+                q._finalize(t, 400, {"error": str(e)})
+            return
+        # 6. fan each group's one result back out to all of its waiters
+        for k, res in zip(order, results):
+            waiters = groups[k]
+            # mode="fanout": N identical requests served by ONE result.
+            # (mode="scenarios" — distinct bodies merged into one batched
+            # device call — is observed by the executor, the layer that
+            # knows the scenario grouping; see server._execute_bodies.)
+            metrics.COALESCED_BATCH.observe(len(waiters), mode="fanout")
+            for t in waiters:
+                if isinstance(res, BaseException):
+                    q._finalize(t, 400, {"error": str(res)})
+                else:
+                    q._finalize(t, 200, res)

@@ -580,6 +580,36 @@ ADMISSION_FENCE = REGISTRY.counter(
     "cross-generation coalescing.",
     labelnames=("outcome",),
 )
+LOOP_ITERATION = REGISTRY.histogram(
+    "osim_loop_iteration_seconds",
+    "Wall-clock duration of one continuous-batching scheduler-loop "
+    "iteration (pack assembly + the device call + fan-out); the EWMA of "
+    "this feeds Retry-After hints.",
+)
+PACK_LATENCY = REGISTRY.histogram(
+    "osim_pack_latency_seconds",
+    "Per-ticket time between admission and the moment its pack was taken "
+    "by the scheduler loop — the queueing cost of continuous batching, "
+    "excluding the device call itself.",
+)
+LANE_OCCUPANCY = REGISTRY.histogram(
+    "osim_lane_occupancy_ratio",
+    "Real scenario lanes over padded lanes (s_real / s_pad) per batched "
+    "device call — how full the SCENARIO_BUCKET-padded shape ran.",
+    buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
+)
+LOOP_FALLBACKS = REGISTRY.counter(
+    "osim_loop_fallbacks_total",
+    "Requests served per-request on the handler thread because the "
+    "scheduler loop thread was not alive (degradation ladder, "
+    "docs/serving.md) — correctness is preserved, batching is lost.",
+)
+JOBS = REGISTRY.counter(
+    "osim_jobs_total",
+    "Async jobs (POST /v1/jobs), by terminal outcome "
+    "(completed | failed | rejected).",
+    labelnames=("outcome",),
+)
 
 # Span names that map onto a dedicated kube-parity histogram; everything
 # else lands only in osim_span_duration_seconds{span=...}.

@@ -11,7 +11,15 @@ depth 4 and asserts the ISSUE-7 overload contract end to end:
      osim_requests_dropped_total == 0;
   3. a request whose deadline has already expired is shed at dequeue and
      NEVER enters a simulate call (proved with a recording wrapper around
-     _simulate_request).
+     _simulate_request);
+  4. (continuous-batching loop) a closed-loop saturation burst against the
+     real simulate path answers every request 200-or-429 with the same
+     exact shed arithmetic, and the sustained req/s lands in the CI job
+     summary when GITHUB_STEP_SUMMARY is set;
+  5. (async jobs) POST /v1/jobs runs a journaled capacity sweep to
+     completion, GET /v1/jobs/<id> streams its sweep progress records,
+     and a resume re-POST replays the journal to a byte-identical
+     outcome.json instead of recomputing.
 
 Runs on CPU in-process; exits nonzero with a labeled failure otherwise.
 """
@@ -19,6 +27,7 @@ Runs on CPU in-process; exits nonzero with a labeled failure otherwise.
 import json
 import os
 import sys
+import tempfile
 import threading
 import time
 import urllib.error
@@ -32,6 +41,8 @@ from open_simulator_tpu.utils import metrics  # noqa: E402
 
 BURST = 32
 DEPTH = 4
+SAT_CLIENTS = 6
+SAT_ROUNDS = 5
 
 
 def _body(tag):
@@ -87,9 +98,9 @@ def _body(tag):
     }
 
 
-def _post(port, body, headers=None, timeout=60.0):
+def _post(port, body, headers=None, timeout=60.0, path="/api/deploy-apps"):
     req = urllib.request.Request(
-        f"http://127.0.0.1:{port}/api/deploy-apps",
+        f"http://127.0.0.1:{port}{path}",
         data=json.dumps(body).encode(),
         headers={"Content-Type": "application/json", **(headers or {})},
     )
@@ -100,9 +111,290 @@ def _post(port, body, headers=None, timeout=60.0):
         return e.code, dict(e.headers), json.loads(e.read() or b"{}")
 
 
+def _get(port, path, timeout=30.0):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout
+        ) as r:
+            return r.status, json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
 def fail(msg):
     print(f"load smoke FAILED: {msg}")
     sys.exit(1)
+
+
+def _closed_loop(port, bodies, rounds):
+    """Closed-loop clients: each posts its body `rounds` times back to
+    back, firing the next request the moment the previous answer lands.
+    Returns the flat [(code, headers)] across all clients."""
+    results = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(len(bodies))
+
+    def client(body):
+        barrier.wait()
+        mine = []
+        for _ in range(rounds):
+            code, headers, _ = _post(port, body, timeout=120.0)
+            mine.append((code, headers))
+        with lock:
+            results.extend(mine)
+
+    threads = [
+        threading.Thread(target=client, args=(b,)) for b in bodies
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300.0)
+    return results
+
+
+def _saturation(n_clients, rounds):
+    """Section 4: sustained closed-loop load against the real simulate
+    path (no recording wrapper, no artificial delays). Bodies differ only
+    in score weights, so the scheduler loop packs them as lanes of one
+    batched device call; the overload contract (200-or-429, exact shed
+    arithmetic, zero drops) must hold at full speed."""
+    srv = server_mod.make_server(0, queue_depth=16, pack_window_ms=50.0)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    base = _body("sat")
+    base.pop("tag")
+    bodies = [
+        dict(base, weights={"least_allocated": 50 + i})
+        for i in range(n_clients)
+    ]
+    try:
+        warm = _closed_loop(port, bodies, 1)
+        warm_bad = sorted({c for c, _ in warm if c != 200})
+        if warm_bad:
+            fail(f"saturation warm-up returned {warm_bad}")
+        shed0 = sum(
+            s["value"] for s in metrics.REQUESTS_SHED.snapshot()["samples"]
+        )
+        dropped0 = metrics.REQUESTS_DROPPED.value()
+
+        t0 = time.time()
+        results = _closed_loop(port, bodies, rounds)
+        wall = time.time() - t0
+
+        want = n_clients * rounds
+        if len(results) != want:
+            fail(f"saturation: {len(results)}/{want} answered (hang/drop)")
+        codes = [c for c, _ in results]
+        bad = sorted({c for c in codes if c not in (200, 429)})
+        if bad:
+            fail(f"saturation: non-200/429 responses {bad} (zero 5xx)")
+        n_ok = codes.count(200)
+        n_shed = codes.count(429)
+        for code, headers in results:
+            if code == 429 and int(headers.get("Retry-After", "0")) < 1:
+                fail(f"saturation: 429 without usable Retry-After {headers}")
+        shed_metric = (
+            sum(s["value"] for s in metrics.REQUESTS_SHED.snapshot()["samples"])
+            - shed0
+        )
+        if shed_metric != n_shed:
+            fail(
+                f"saturation: shed metric moved {shed_metric} != "
+                f"{n_shed} shed responses"
+            )
+        if metrics.REQUESTS_DROPPED.value() != dropped0:
+            fail("saturation: a request was dropped")
+        req_s = round(n_ok / wall, 1) if wall > 0 else 0.0
+        print(
+            f"saturation OK: {n_ok}x200 + {n_shed}x429 = {want} over "
+            f"{round(wall, 2)}s -> {req_s} req/s sustained"
+        )
+        return {
+            "clients": n_clients,
+            "rounds": rounds,
+            "ok": n_ok,
+            "shed": n_shed,
+            "wall_s": round(wall, 2),
+            "req_s": req_s,
+        }
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def _jobs_smoke():
+    """Section 5: an async capacity job over /v1/jobs. The sweep journals
+    its phases; GET streams them as progress; a resume re-POST replays the
+    journal and must land a byte-identical outcome.json (the snapshot is
+    deliberately timestamp-free) instead of recomputing."""
+    tmp = tempfile.mkdtemp(prefix="osim-jobs-smoke-")
+    prior = os.environ.get("OSIM_RUNS_DIR")
+    os.environ["OSIM_RUNS_DIR"] = tmp
+    srv = server_mod.make_server(0, queue_depth=DEPTH, pack_window_ms=0.0)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    res = {"cpu": "4", "memory": "8Gi", "pods": "110"}
+    body = {
+        "kind": "capacity",
+        "job": "smoke-capacity",
+        "cluster": {
+            "objects": [
+                {
+                    "kind": "Node",
+                    "metadata": {
+                        "name": f"cap-{i}",
+                        "labels": {"kubernetes.io/hostname": f"cap-{i}"},
+                    },
+                    "status": {
+                        "allocatable": dict(res), "capacity": dict(res),
+                    },
+                }
+                for i in range(2)
+            ]
+        },
+        # 12 cpu of pods on 8 cpu of nodes: the sweep MUST add capacity,
+        # so at least one ladder phase lands in the journal as progress
+        "apps": [
+            {
+                "name": "web",
+                "objects": [
+                    {
+                        "kind": "Deployment",
+                        "metadata": {"name": "web", "namespace": "smoke"},
+                        "spec": {
+                            "replicas": 12,
+                            "template": {
+                                "metadata": {"labels": {"app": "web"}},
+                                "spec": {
+                                    "containers": [
+                                        {
+                                            "name": "c",
+                                            "image": "img",
+                                            "resources": {
+                                                "requests": {
+                                                    "cpu": "1",
+                                                    "memory": "512Mi",
+                                                }
+                                            },
+                                        }
+                                    ]
+                                },
+                            },
+                        },
+                    }
+                ],
+            }
+        ],
+        "newNode": {
+            "kind": "Node",
+            "metadata": {
+                "name": "cap-new",
+                "labels": {"kubernetes.io/hostname": "cap-new"},
+            },
+            "status": {
+                "allocatable": {
+                    "cpu": "16", "memory": "32Gi", "pods": "110",
+                },
+                "capacity": {
+                    "cpu": "16", "memory": "32Gi", "pods": "110",
+                },
+            },
+        },
+    }
+
+    def poll_to_completion():
+        deadline = time.time() + 120.0
+        after, status, progress, last = -1, None, [], {}
+        while time.time() < deadline:
+            code, st = _get(port, f"/v1/jobs/smoke-capacity?after={after}")
+            if code != 200:
+                fail(f"job status returned {code}: {st}")
+            progress.extend(st.get("progress") or [])
+            after = st.get("next_after", after)
+            status, last = st["status"], st
+            if status in ("completed", "failed", "interrupted"):
+                break
+            time.sleep(0.2)
+        return status, progress, last
+
+    try:
+        code, _, payload = _post(port, body, path="/v1/jobs")
+        if code != 202:
+            fail(f"job submit returned {code}: {payload}")
+        status, progress, last = poll_to_completion()
+        if status != "completed":
+            fail(f"job finished as {status!r}: {last}")
+        if not progress:
+            fail("job streamed NO sweep progress records")
+        outcome = last.get("outcome") or {}
+        if outcome.get("outcome") != "ok":
+            fail(f"capacity job outcome not ok: {outcome}")
+        if outcome.get("nodes_added", 0) < 1:
+            fail(f"workload was sized to need capacity: {outcome}")
+        outcome_path = os.path.join(tmp, "smoke-capacity", "outcome.json")
+        with open(outcome_path, "rb") as fh:
+            first_bytes = fh.read()
+
+        # resume re-POST: replays the committed journal, no recompute
+        code, _, payload = _post(
+            port, dict(body, resume=True), path="/v1/jobs"
+        )
+        if code != 202:
+            fail(f"job resume returned {code}: {payload}")
+        status, _, last = poll_to_completion()
+        if status != "completed":
+            fail(f"job resume finished as {status!r}: {last}")
+        with open(outcome_path, "rb") as fh:
+            if fh.read() != first_bytes:
+                fail("resume replay changed outcome.json (recomputed?)")
+
+        code, listing = _get(port, "/v1/jobs")
+        names = [j.get("name") for j in listing.get("jobs", [])]
+        if code != 200 or "smoke-capacity" not in names:
+            fail(f"/v1/jobs listing missing the job: {code} {names}")
+        print(
+            f"jobs OK: capacity sweep completed with "
+            f"{len(progress)} progress records, "
+            f"nodes_added={outcome['nodes_added']}, resume byte-identical"
+        )
+        return {
+            "job": "smoke-capacity",
+            "sweep_records": len(progress),
+            "nodes_added": outcome["nodes_added"],
+            "resume": "byte-identical",
+        }
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        if prior is None:
+            os.environ.pop("OSIM_RUNS_DIR", None)
+        else:
+            os.environ["OSIM_RUNS_DIR"] = prior
+
+
+def _publish_summary(n_ok, n_shed, sat, jobs):
+    """Append the human-readable result to the CI job summary when GitHub
+    provides one (GITHUB_STEP_SUMMARY); silently a no-op locally."""
+    path = os.environ.get("GITHUB_STEP_SUMMARY")
+    if not path:
+        return
+    lines = [
+        "### Serving load smoke",
+        "",
+        f"- overload burst: {n_ok}x200 + {n_shed}x429 = {BURST} "
+        f"(depth {DEPTH}), zero 5xx, zero drops",
+        f"- sustained throughput: **{sat['req_s']} req/s** "
+        f"({sat['clients']} closed-loop clients x {sat['rounds']} rounds, "
+        f"{sat['ok']}x200 + {sat['shed']}x429)",
+        f"- async job `{jobs['job']}`: {jobs['sweep_records']} sweep "
+        f"progress records, nodes_added={jobs['nodes_added']}, "
+        f"resume replay byte-identical",
+        "",
+    ]
+    with open(path, "a") as fh:
+        fh.write("\n".join(lines))
 
 
 def main():
@@ -224,6 +516,18 @@ def main():
 
     srv.shutdown()
     srv.server_close()
+
+    # --- 4: closed-loop saturation against the REAL simulate path ---------
+    # No recording wrapper and no artificial delays: this is the sustained
+    # req/s the continuous-batching loop actually delivers on this runner,
+    # under the same 200-or-429 + exact-shed-arithmetic contract.
+    server_mod._simulate_request = real_simulate
+    sat = _saturation(SAT_CLIENTS, SAT_ROUNDS)
+
+    # --- 5: async jobs — journaled capacity sweep over /v1/jobs ------------
+    jobs = _jobs_smoke()
+
+    _publish_summary(n_ok, n_shed, sat, jobs)
     print(
         json.dumps(
             {
@@ -232,6 +536,8 @@ def main():
                 "ok": n_ok,
                 "shed": n_shed,
                 "dropped": 0,
+                "saturation": sat,
+                "jobs": jobs,
             }
         )
     )

@@ -1,0 +1,398 @@
+"""Continuous-batching scheduler loop (server/loop.py + warm sessions).
+
+The loop's contracts, each provable without wall-clock sleeps where
+possible (ManualClock + run_pending), and with Event-gated real workers
+where thread interleaving IS the thing under test:
+
+* pack heuristic: lone ticket and full pack dispatch immediately; only a
+  partial pack may wait, bounded by the pack window;
+* a ticket arriving while a pack is mid-flight lands in the NEXT pack —
+  never two iterations later;
+* the generation fence is consulted once per pack and re-keys moved
+  tickets before coalescing;
+* a pack of one served by a warm ScenarioSession is byte-identical to a
+  cold serial simulate() — on the first call and on every call after;
+* Retry-After derives from the observed loop-iteration EWMA times queue
+  depth, with a flat non-degenerate hint before the first iteration.
+"""
+
+import json
+import threading
+
+import pytest
+
+from open_simulator_tpu.core.workloads import reset_name_rng
+from open_simulator_tpu.engine.simulator import (
+    AppResource,
+    ClusterResource,
+    Scenario,
+    ScenarioSession,
+    simulate,
+)
+from open_simulator_tpu.server import server as server_mod
+from open_simulator_tpu.server.admission import (
+    DEFAULT_SERVICE_TIME_S,
+    AdmissionQueue,
+)
+from open_simulator_tpu.server.loop import default_pack_lanes, pack_ready
+from open_simulator_tpu.utils import metrics
+from tests.factories import make_deployment, make_node
+
+
+class ManualClock:
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _recorder():
+    calls = []
+
+    def execute(bodies):
+        calls.append(list(bodies))
+        return [{"echo": b} for b in bodies]
+
+    return execute, calls
+
+
+# ---------------------------------------------------------------------------
+# pack heuristic
+# ---------------------------------------------------------------------------
+
+
+def test_pack_ready_lone_and_full_dispatch_immediately():
+    assert not pack_ready(0, depth=16, pack_lanes=8)
+    assert pack_ready(1, depth=16, pack_lanes=8)       # lone: no latency floor
+    assert pack_ready(8, depth=16, pack_lanes=8)       # full bucket
+    assert pack_ready(12, depth=16, pack_lanes=8)
+    # partial packs wait (bounded by the window)
+    for n in range(2, 8):
+        assert not pack_ready(n, depth=16, pack_lanes=8)
+    # ...unless the queue depth is the binding constraint
+    assert pack_ready(4, depth=4, pack_lanes=8)
+
+
+def test_pack_ready_lone_holds_under_saturation():
+    """A lone ticket right behind a multi-lane pack is the head of a
+    re-posting herd: it waits for the herd (bounded by the window) rather
+    than burning a device call on one lane. Full packs are unaffected."""
+    assert not pack_ready(1, depth=16, pack_lanes=8, saturated=True)
+    assert pack_ready(1, depth=16, pack_lanes=8, saturated=False)
+    assert pack_ready(8, depth=16, pack_lanes=8, saturated=True)
+    assert not pack_ready(4, depth=16, pack_lanes=8, saturated=True)
+
+
+def test_default_pack_lanes_is_the_scenario_bucket():
+    from open_simulator_tpu.ops.fast import SCENARIO_BUCKET
+
+    assert default_pack_lanes() == SCENARIO_BUCKET
+
+
+def test_lone_request_does_not_wait_out_the_pack_window():
+    """A 60-second pack window must NOT delay a lone request: the loop
+    dispatches it immediately (the old coalesce window was a latency
+    floor; the pack window is only an upper bound for partial packs)."""
+    execute, calls = _recorder()
+    q = AdmissionQueue(execute, depth=8, pack_window_ms=60_000.0).start()
+    try:
+        t = q.submit({"a": 1}, key="k")
+        assert t.done.wait(10.0)  # would time out under a window floor
+        assert t.code == 200
+        assert calls == [[{"a": 1}]]
+    finally:
+        q.shutdown()
+        q.join(10.0)
+
+
+# ---------------------------------------------------------------------------
+# continuous batching: mid-flight arrivals join the NEXT pack
+# ---------------------------------------------------------------------------
+
+
+def test_midflight_arrivals_land_in_the_very_next_pack():
+    calls = []
+    first_entered = threading.Event()
+    release = threading.Event()
+
+    def execute(bodies):
+        calls.append(list(bodies))
+        if len(calls) == 1:
+            first_entered.set()
+            assert release.wait(10.0)
+        return [{"ok": 1} for _ in bodies]
+
+    q = AdmissionQueue(execute, depth=8, pack_window_ms=0.0).start()
+    try:
+        q.submit({"a": 1}, key="k1")
+        assert first_entered.wait(10.0)  # pack 1 is on the device
+        t2 = q.submit({"a": 2}, key="k2")
+        t3 = q.submit({"a": 3}, key="k3")
+        release.set()
+        q.wait(t2)
+        q.wait(t3)
+        # both mid-flight arrivals were served by ONE follow-up pack —
+        # neither waited an extra iteration
+        assert len(calls) == 2
+        assert calls[1] == [{"a": 2}, {"a": 3}]
+    finally:
+        q.shutdown()
+        q.join(10.0)
+
+
+# ---------------------------------------------------------------------------
+# per-pack fence re-keying
+# ---------------------------------------------------------------------------
+
+
+def test_fence_moved_tickets_rekeyed_before_coalescing():
+    execute, calls = _recorder()
+    epoch = {"v": 1}
+    q = AdmissionQueue(
+        execute, depth=8, pack_window_ms=0.0, clock=ManualClock(),
+        fence=lambda: epoch["v"],
+    )
+    t1 = q.submit({"a": 1}, key="k", fence_epoch=1)
+    t2 = q.submit({"a": 1}, key="k", fence_epoch=1)
+    epoch["v"] = 2  # snapshot moved while the pack was queued
+    q.run_pending()
+    # both tickets re-keyed onto the current epoch — identically, so they
+    # still coalesce into one executor entry and both answer 200
+    assert t1.key.endswith("@fence2")
+    assert t1.key == t2.key
+    assert calls == [[{"a": 1}]]
+    assert t1.code == t2.code == 200
+
+    # a later pack admitted AT the current epoch is not re-keyed
+    t3 = q.submit({"a": 1}, key="k2", fence_epoch=2)
+    q.run_pending()
+    assert t3.key == "k2"
+    assert t3.code == 200
+
+
+# ---------------------------------------------------------------------------
+# pack of one == serial simulate(), warm call after warm call
+# ---------------------------------------------------------------------------
+
+
+def digest(result) -> str:
+    doc = {
+        "placements": {
+            st.node.name: sorted(p.key for p in st.pods)
+            for st in result.node_status
+        },
+        "unscheduled": sorted(
+            (u.pod.key, u.reason) for u in result.unscheduled
+        ),
+    }
+    return json.dumps(doc, sort_keys=True)
+
+
+def _fixture():
+    cluster = ClusterResource(
+        nodes=[make_node(f"node-{i}", cpu="8", memory="16Gi")
+               for i in range(4)]
+    )
+    apps = [
+        AppResource(
+            name="app",
+            objects=[
+                make_deployment("web", replicas=10, cpu="1", memory="1Gi"),
+                make_deployment("db", replicas=3, cpu="2", memory="2Gi"),
+            ],
+        )
+    ]
+    return cluster, apps
+
+
+def test_session_pack_of_one_byte_identical_to_serial_simulate():
+    cluster, apps = _fixture()
+    reset_name_rng()
+    want = digest(simulate(cluster, apps))
+
+    reset_name_rng()
+    sess = ScenarioSession(cluster, apps)
+    # the FIRST warm call and every call after must match the cold serial
+    # digest exactly — the session rewinds the name RNG per run, so call
+    # count is not observable in the results
+    for call in range(3):
+        results = sess.run([Scenario(name="req-0")])
+        assert results is not None and len(results) == 1
+        assert digest(results[0]) == want, f"warm call {call} diverged"
+    assert sess.calls == 3
+
+
+def test_session_lanes_match_serial_across_reused_calls():
+    cluster, apps = _fixture()
+    spread = {"least_allocated": 100}
+    reset_name_rng()
+    want_default = digest(simulate(cluster, apps))
+    reset_name_rng()
+    want_spread = digest(simulate(cluster, apps, weights=spread))
+
+    reset_name_rng()
+    sess = ScenarioSession(cluster, apps)
+    for _ in range(2):  # second iteration exercises reuse_state=True
+        results = sess.run(
+            [
+                Scenario(name="default"),
+                Scenario(name="spread", weights=spread),
+            ]
+        )
+        assert results is not None
+        assert digest(results[0]) == want_default
+        assert digest(results[1]) == want_spread
+
+
+def test_server_scenario_group_reuses_one_warm_session(monkeypatch):
+    """Two identical scenario groups through the server executor: the first
+    creates a warm session, the second reuses it (calls == 2) — the pack's
+    encode cost is paid once."""
+    monkeypatch.delenv("OSIM_SERVER_LOOP", raising=False)
+    with server_mod._sessions_lock:
+        server_mod._sessions.clear()
+    res = {"cpu": "8", "memory": "16Gi", "pods": "110"}
+    nodes = [
+        {
+            "kind": "Node",
+            "apiVersion": "v1",
+            "metadata": {
+                "name": f"node-{i}",
+                "labels": {"kubernetes.io/hostname": f"node-{i}"},
+            },
+            "status": {"allocatable": dict(res), "capacity": dict(res)},
+        }
+        for i in range(3)
+    ]
+    body = {
+        "cluster": {"objects": nodes},
+        "apps": [
+            {
+                "name": "app",
+                "objects": [
+                    make_deployment("web", replicas=4, cpu="1", memory="1Gi")
+                ],
+            }
+        ],
+    }
+    bodies = [dict(body), dict(body, weights={"least_allocated": 100})]
+    out1 = server_mod._execute_bodies(list(bodies))
+    assert all(isinstance(r, dict) for r in out1)
+    with server_mod._sessions_lock:
+        assert len(server_mod._sessions) == 1
+        ent = next(iter(server_mod._sessions.values()))
+        assert ent["session"].calls == 1
+        assert not ent["busy"]
+    out2 = server_mod._execute_bodies(list(bodies))
+    assert out2 == out1  # warm pack byte-identical to the first
+    with server_mod._sessions_lock:
+        assert next(iter(server_mod._sessions.values()))["session"].calls == 2
+        server_mod._sessions.clear()
+
+
+def test_loop_dead_requests_served_per_request_on_handler_thread(monkeypatch):
+    """Degradation ladder: with the scheduler-loop thread dead, POSTs are
+    served per-request on the handler thread (200, osim_loop_fallbacks_total
+    counts them) instead of queueing against a worker that will never run."""
+    import urllib.request
+
+    monkeypatch.setattr(
+        server_mod, "_simulate_request",
+        lambda body: {"placements": {}, "unscheduled": []},
+    )
+    srv = server_mod.make_server(0, queue_depth=2, coalesce_ms=0.0)
+    real_worker = srv.admission._worker
+    srv.admission._worker = threading.Thread(target=lambda: None)  # dead
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    port = srv.server_address[1]
+    before = metrics.LOOP_FALLBACKS.value()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/deploy-apps",
+            data=b"{}",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+            assert json.loads(r.read()) == {
+                "placements": {}, "unscheduled": [],
+            }
+        assert metrics.LOOP_FALLBACKS.value() == before + 1
+    finally:
+        srv.admission._worker = real_worker
+        srv.shutdown()
+        srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# Retry-After: loop-iteration EWMA x queue depth
+# ---------------------------------------------------------------------------
+
+
+def test_retry_after_cold_start_is_flat_default_not_backlog_scaled():
+    """Before ANY iteration completes there is no observed iteration time;
+    the hint must be the flat DEFAULT_SERVICE_TIME_S — not 0, not None, and
+    not multiplied by a backlog the estimate knows nothing about."""
+    q = AdmissionQueue(
+        lambda b: [{"ok": 1}] * len(b), depth=2, pack_window_ms=0.0,
+        clock=ManualClock(),
+    )
+    q.submit({"a": 1}, key="k1")
+    q.submit({"a": 2}, key="k2")
+    shed = q.submit({"a": 3}, key="k3")
+    assert shed.code == 429
+    assert shed.headers["Retry-After"] == str(
+        max(1, int(DEFAULT_SERVICE_TIME_S))
+    )
+
+
+def test_retry_after_tracks_loop_iteration_ewma_times_depth():
+    clk = ManualClock()
+
+    def execute(bodies):
+        clk.advance(2.0)  # each loop iteration "takes" 2 s
+        return [{"ok": 1}] * len(bodies)
+
+    q = AdmissionQueue(execute, depth=2, pack_window_ms=0.0, clock=clk)
+    q.submit({"a": 1}, key="k1")
+    q.run_pending()  # one completed iteration: EWMA == 2.0 s
+    q.submit({"a": 2}, key="k2")
+    q.submit({"a": 3}, key="k3")
+    shed = q.submit({"a": 4}, key="k4")
+    assert shed.code == 429
+    # 2 queued ahead + this request, at 2 s per observed loop iteration
+    assert shed.headers["Retry-After"] == "6"
+
+    # the estimate is an EWMA of ITERATION time, so one later fast
+    # iteration pulls the hint down rather than resetting it
+    def fast(bodies):
+        clk.advance(0.5)
+        return [{"ok": 1}] * len(bodies)
+
+    q._execute = fast
+    q.run_pending()
+    # EWMA = 0.3*0.5 + 0.7*2.0 = 1.55; one queued ticket + the prospective
+    # request = 2 iterations ahead => ceil(1.55 * 2) = 4 (down from 6)
+    q2 = q.submit({"a": 5}, key="k5")
+    with q._cv:
+        hint = q._retry_hint_locked()
+    assert hint == 4
+    q.run_pending()
+    assert q2.code == 200
+
+
+def test_pack_window_env_precedence_and_deprecated_alias(monkeypatch):
+    monkeypatch.setenv("OSIM_SERVER_PACK_WINDOW_MS", "40")
+    monkeypatch.setenv("OSIM_SERVER_COALESCE_MS", "90")
+    q = AdmissionQueue(lambda b: [], clock=ManualClock())
+    assert q.coalesce_s == pytest.approx(0.040)  # new knob wins over alias
+    monkeypatch.delenv("OSIM_SERVER_PACK_WINDOW_MS")
+    q = AdmissionQueue(lambda b: [], clock=ManualClock())
+    assert q.coalesce_s == pytest.approx(0.090)  # alias still honored
+    # explicit parameter beats both
+    q = AdmissionQueue(lambda b: [], pack_window_ms=10.0, clock=ManualClock())
+    assert q.coalesce_s == pytest.approx(0.010)
