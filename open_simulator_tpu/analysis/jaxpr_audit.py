@@ -69,7 +69,10 @@ AUDIT_TARGETS: Dict[str, Tuple[str, ...]] = {
         "schedule_scenarios",
     ),
     "open_simulator_tpu.ops.grouped": ("_group_jit",),
-    "open_simulator_tpu.ops.kernels": ("schedule_batch", "probe_step", "commit_step"),
+    "open_simulator_tpu.ops.kernels": (
+        "schedule_batch", "probe_step", "commit_step", "probe_many",
+        "commit_wave",
+    ),
     "open_simulator_tpu.ops.delta": ("apply_rows", "apply_flags", "digest_fold"),
 }
 
@@ -90,6 +93,8 @@ REQUIRED_COVERAGE = frozenset(
         "ops.kernels:schedule_batch",
         "ops.kernels:probe_step",
         "ops.kernels:commit_step",
+        "ops.kernels:probe_many",
+        "ops.kernels:commit_wave",
         "ops.delta:apply_rows",
         "ops.delta:apply_flags",
         "ops.delta:digest_fold",
@@ -392,6 +397,19 @@ def _capture_calls() -> List[_Captured]:
         row0 = _tree_first(rows)
         kernels.probe_step(ns, carry, row0, weights)
         kernels.commit_step(ns, carry, row0, jnp.int32(0))
+        # the extender wave entries (engine/extender_wave.py): one bucketed
+        # wave of pad-copied lanes, the exact shape discipline the wave
+        # engine ships (lane 0 commits, the rest only recheck)
+        w_pad = fast.scenario_bucket(2)
+        rows_w = jax.tree.map(
+            lambda a: jnp.broadcast_to(a[:1], (w_pad,) + a.shape[1:]), rows
+        )
+        mask_w, score_w, ff_w = kernels.probe_many(ns, carry, rows_w, weights)
+        want_w = jnp.zeros(w_pad, bool).at[0].set(True)
+        kernels.commit_wave(
+            ns, carry, rows_w, weights, mask_w, ff_w, mask_w,
+            jnp.zeros_like(score_w), want_w,
+        )
         # the batched scenario engine (`schedule_scenarios`): a 2-lane
         # what-if sweep padded to the scenario bucket, the exact shapes
         # Simulator.run_scenarios ships (lane 1 masks off half the nodes;

@@ -11,9 +11,11 @@ builds:
   2. **locks** — module-level ``threading.Lock()``/``RLock()``/
      ``Semaphore()``-style bindings;
   3. **thread roots** — methods of ``BaseHTTPRequestHandler`` subclasses,
-     ``threading.Thread(target=...)`` targets and ``signal.signal``
-     handlers, then everything reachable from them through the call graph
-     (with ``self.method`` resolution inside classes).
+     ``threading.Thread(target=...)`` targets, ``executor.submit(fn, ...)``
+     work items (the extender wave engine's HTTP fan-out) and
+     ``signal.signal`` handlers, then everything reachable from them
+     through the call graph (with ``self.method`` resolution inside
+     classes).
 
 Any read-modify-write of a shared scalar (AugAssign, ``x = f(x)``, or a
 read + rebind pair in one function) and any container mutation
@@ -308,6 +310,12 @@ def thread_roots(ctx: LintContext) -> Dict[Tuple[str, str], str]:
                     kw.value for kw in node.keywords if kw.arg == "target"
                 ]
                 reason = "thread target"
+            elif callee == "submit" and node.args:
+                # executor.submit(fn, ...) — ThreadPoolExecutor work items
+                # run on pool threads (the extender wave engine's HTTP fan
+                # out); audit the submitted callable like a Thread target
+                target_exprs = [node.args[0]]
+                reason = "executor task"
             elif callee == "signal" and len(node.args) >= 2:
                 target_exprs = [node.args[1]]
                 reason = "signal handler"

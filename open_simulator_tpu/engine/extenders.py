@@ -24,6 +24,7 @@ extender written for the reference works against this engine unchanged.
 
 from __future__ import annotations
 
+import http.client
 import json
 import time
 import urllib.error
@@ -34,7 +35,7 @@ from ..core.objects import Node, Pod
 from ..models.profiles import ExtenderConfig
 from ..resilience import faults
 from ..resilience.policy import RetryExhaustedError, RetryPolicy, breaker_for
-from ..utils import metrics
+from ..utils import httppool, metrics
 from ..utils.tracing import log
 
 # framework.MaxNodeScore / extenderv1.MaxExtenderPriority (100 / 10)
@@ -144,6 +145,10 @@ class HTTPExtender:
         # state survives the per-simulate() rebuild of HTTPExtender objects.
         self.policy = policy if policy is not None else RetryPolicy.from_env()
         self.breaker = breaker_for(self.base)
+        # a pod's wire JSON is identical across its filter and prioritize
+        # calls; id() keys are safe because pods outlive the per-simulate()
+        # extender object holding this cache
+        self._pod_json_cache: Dict[int, dict] = {}
 
     # -- extender.go:440-468 ------------------------------------------------
     def is_interested(self, pod: Pod) -> bool:
@@ -162,21 +167,18 @@ class HTTPExtender:
         return self.cfg.ignorable
 
     def _roundtrip(self, url: str, verb: str, data: bytes,
-                   timeout: Optional[float]) -> dict:
-        """One HTTP attempt. Transient failures (connection/timeout, HTTP
-        5xx, malformed JSON) raise TransientExtenderError; everything else
-        raises plain ExtenderError and is never retried."""
-        rule = faults.maybe_inject("extender", verb)
+                   timeout: Optional[float], key: str = "") -> dict:
+        """One HTTP attempt over the shared keep-alive pool. Transient
+        failures (connection/timeout, HTTP 5xx, malformed JSON) raise
+        TransientExtenderError; everything else raises plain ExtenderError
+        and is never retried. `key` (pod UID) keys fault injection so a plan
+        replays byte-identically under the concurrent wave engine."""
+        rule = faults.maybe_inject("extender", verb, key=key)
         body: Optional[bytes] = None
         try:
             if rule is not None:
                 body = faults.apply_http_fault(rule, url)
             if body is None:
-                req = urllib.request.Request(
-                    url, data=data,
-                    headers={"Content-Type": "application/json"},
-                    method="POST",
-                )
                 # http_timeout_s == 0 means no client timeout (Go zero
                 # Timeout); a retry policy deadline may tighten it further
                 eff = timeout
@@ -186,13 +188,50 @@ class HTTPExtender:
                         if eff is None
                         else min(eff, self.cfg.http_timeout_s)
                     )
-                with urllib.request.urlopen(req, timeout=eff) as resp:
-                    body = resp.read()
+                if not httppool.keepalive_enabled():
+                    # transport escape hatch (OSIM_EXTENDER_KEEPALIVE=0):
+                    # one fresh connection per request; urlopen raises
+                    # HTTPError on >= 400, handled below like fault-plan
+                    # errors
+                    req = urllib.request.Request(
+                        url, data=data, method="POST",
+                        headers={"Content-Type": "application/json"},
+                    )
+                    with urllib.request.urlopen(req, timeout=eff) as resp:
+                        body = resp.read()
+                else:
+                    pool, path = httppool.pool_for(url)
+                    status, reason, raw = pool.request(
+                        "POST", path, data,
+                        {"Content-Type": "application/json"}, eff,
+                    )
+                    if status >= 400:
+                        snippet = (
+                            raw[:ERROR_BODY_SNIPPET_BYTES]
+                            .decode("utf-8", "replace").strip()
+                        )
+                        detail = f"HTTP {status} {reason}"
+                        if snippet:
+                            detail = f"{detail}: {snippet}"
+                        cls = (
+                            TransientExtenderError
+                            if status >= 500
+                            else ExtenderError
+                        )
+                        raise cls(f"extender {url}: {detail}")
+                    body = raw
         except urllib.error.HTTPError as e:
+            # raised by the fault plan (apply_http_fault keeps the old
+            # transport's exception shape) and by the keepalive=0 transport
             detail = _http_error_detail(e)
             cls = TransientExtenderError if e.code >= 500 else ExtenderError
             raise cls(f"extender {url}: {detail}")
-        except (urllib.error.URLError, OSError, TimeoutError) as e:
+        except ExtenderError:
+            raise
+        except (
+            urllib.error.URLError, http.client.HTTPException, OSError,
+            TimeoutError,
+        ) as e:
             raise TransientExtenderError(f"extender {url}: {e}")
         try:
             return json.loads(body) or {}
@@ -202,12 +241,16 @@ class HTTPExtender:
                 f"extender {url}: invalid JSON response: {e}"
             )
 
-    def _send(self, verb: str, args: dict, retry: bool = True) -> dict:
+    def _send(
+        self, verb: str, args: dict, retry: bool = True, key: str = ""
+    ) -> dict:
         url = f"{self.base}/{verb}"
         data = json.dumps(args).encode()
         t0 = time.monotonic()
+        outcome = "ok"
         try:
             if not self.breaker.allow():
+                outcome = "circuit_open"
                 metrics.EXTENDER_REQUESTS.inc(
                     verb=verb, outcome="circuit_open"
                 )
@@ -217,12 +260,12 @@ class HTTPExtender:
             try:
                 if retry:
                     out = self.policy.execute(
-                        lambda t: self._roundtrip(url, verb, data, t),
+                        lambda t: self._roundtrip(url, verb, data, t, key),
                         retryable=(TransientExtenderError,),
                         target="extender",
                     )
                 else:
-                    out = self._roundtrip(url, verb, data, None)
+                    out = self._roundtrip(url, verb, data, None, key)
             except RetryExhaustedError as e:
                 self.breaker.record_failure(str(e.last_exc))
                 # stays Transient: the capacity planner re-runs trials that
@@ -233,16 +276,26 @@ class HTTPExtender:
                 raise
             self.breaker.record_success()
         except ExtenderError:
+            if outcome == "ok":
+                outcome = "error"
             metrics.EXTENDER_REQUESTS.inc(verb=verb, outcome="error")
             raise
+        finally:
+            # error and fail-fast outcomes cost wall time too; the old
+            # success-only observation hid retry storms from the histogram
+            metrics.EXTENDER_DURATION.observe(
+                time.monotonic() - t0, verb=verb, outcome=outcome
+            )
         metrics.EXTENDER_REQUESTS.inc(verb=verb, outcome="ok")
-        metrics.EXTENDER_DURATION.observe(time.monotonic() - t0, verb=verb)
         return out
 
     def _wire_args(self, pod: Pod, nodes: Sequence[Node]) -> dict:
         """ExtenderArgs{Pod, Nodes|NodeNames} — shared by filter and
         prioritize so the wire shape can't diverge between verbs."""
-        args: dict = {"Pod": _pod_json(pod)}
+        pj = self._pod_json_cache.get(id(pod))
+        if pj is None:
+            pj = self._pod_json_cache[id(pod)] = _pod_json(pod)
+        args: dict = {"Pod": pj}
         if self.cfg.node_cache_capable:
             args["NodeNames"] = [n.name for n in nodes]
             args["Nodes"] = None
@@ -261,7 +314,10 @@ class HTTPExtender:
         if not self.cfg.filter_verb:
             return list(nodes), {}
         by_name = {n.name: n for n in nodes}
-        result = self._send(self.cfg.filter_verb, self._wire_args(pod, nodes))
+        result = self._send(
+            self.cfg.filter_verb, self._wire_args(pod, nodes),
+            key=_pod_uid(pod),
+        )
         if result.get("Error"):
             raise ExtenderError(
                 f"extender {self.base}: {result['Error']}"
@@ -342,7 +398,9 @@ class HTTPExtender:
         # ProcessPreemption is NOT retried: the verb mutates extender-side
         # victim bookkeeping in real deployments, so only the idempotent
         # filter/prioritize verbs ride the retry policy.
-        result = self._send(self.cfg.preempt_verb, args, retry=False)
+        result = self._send(
+            self.cfg.preempt_verb, args, retry=False, key=_pod_uid(pod)
+        )
         # The extender always returns NodeNameToMetaVictims (extender.go:195)
         out: Dict[str, Tuple[List[Pod], int]] = {}
         for node, meta in (result.get("NodeNameToMetaVictims") or {}).items():
@@ -379,7 +437,10 @@ class HTTPExtender:
         caller scales the combined sum by EXTENDER_SCORE_SCALE)."""
         if not self.cfg.prioritize_verb:
             return {}
-        result = self._send(self.cfg.prioritize_verb, self._wire_args(pod, nodes))
+        result = self._send(
+            self.cfg.prioritize_verb, self._wire_args(pod, nodes),
+            key=_pod_uid(pod),
+        )
         out: Dict[str, float] = {}
         entries = result if isinstance(result, list) else []
         for item in entries:

@@ -510,22 +510,25 @@ class Simulator:
             # Only pods some extender is interested in pay the per-pod HTTP
             # path; consecutive uninterested runs keep the fused batch scan.
             # Splitting by CONSECUTIVE runs preserves the exact sequential-
-            # commit order across the whole batch.
+            # commit order across the whole batch. The per-pod per-extender
+            # interest vector is computed ONCE here and reused by the wave
+            # engine / serial loop (it used to be recomputed per extender
+            # per pod in the hot loop and again in this split).
+            interest = [
+                tuple(e.is_interested(p) for e in self._extenders)
+                for p in pods
+            ]
             failed: List[UnscheduledPod] = []
             i = 0
             while i < len(pods):
                 j = i
-                interested = any(
-                    e.is_interested(pods[i]) for e in self._extenders
-                )
-                while j < len(pods) and interested == any(
-                    e.is_interested(pods[j]) for e in self._extenders
-                ):
+                interested = any(interest[i])
+                while j < len(pods) and interested == any(interest[j]):
                     j += 1
                 if interested:
                     failed.extend(
                         self._schedule_run_extenders(
-                            pods[i:j], weights, filter_on
+                            pods[i:j], weights, filter_on, interest[i:j]
                         )
                     )
                 else:
@@ -616,27 +619,36 @@ class Simulator:
         metrics.SCHEDULE_RESULT.inc(result="scheduled")
 
     def _schedule_run_extenders(
-        self, pods: List[Pod], weights, filter_on
+        self, pods: List[Pod], weights, filter_on, interest=None
     ) -> List[UnscheduledPod]:
-        """Per-pod scheduling with extenders folded in (the split point
+        """Scheduling with extenders folded in (the split point
         generic_scheduler.go sits at: device filters → extender Filter chain
         (findNodesThatPassExtenders, :345-374) → device scores + extender
         Prioritize × weight × MaxNodeScore/MaxExtenderPriority (:521-555) →
-        argmax → device commit). One probe + one commit device call per pod —
-        the HTTP round trip dominates either way, exactly as it does in the
-        reference."""
+        argmax → device commit). Default path: the wave pipeline
+        (engine/extender_wave.py) — probe a whole wave in one device call,
+        fan the HTTP chains across pooled connections, commit through a
+        conflict-rechecking scan. OSIM_EXTENDER_WAVE=0 falls back to the
+        legacy per-pod loop below; both produce byte-identical placements
+        (docs/performance.md)."""
         import jax
         import jax.numpy as jnp
 
         from ..ops.kernels import commit_step, probe_step
         from ..ops.state import pod_rows_from_batch_host
         from ..utils.tracing import log
+        from . import extender_wave
         from .extenders import (
             EXTENDER_SCORE_SCALE,
             ExtenderError,
             TransientExtenderError,
         )
 
+        if interest is None:
+            interest = [
+                tuple(e.is_interested(p) for e in self._extenders)
+                for p in pods
+            ]
         with span("encode", pods=len(pods)):
             batch = encode_pods(self.enc, pods)
             # host-side row table: per-pod slicing below is numpy (free);
@@ -647,8 +659,20 @@ class Simulator:
         failed: List[UnscheduledPod] = []
         n_nodes = len(self.cluster.nodes)
         scheduled = 0
+        wave = extender_wave.wave_size()
         with span("schedule-extenders", pods=len(pods)) as sp:
-            for i, pod in enumerate(pods):
+            if wave > 0:
+                failed, scheduled = extender_wave.run_waves(
+                    self, pods, rows, weights, fo, interest, wave
+                )
+                sp.meta["scheduled"] = scheduled
+                pods_iter: List[Pod] = []
+            else:
+                pods_iter = pods
+            for i, pod in enumerate(pods_iter):
+                interested = [
+                    e for e, hit in zip(self._extenders, interest[i]) if hit
+                ]
                 row = jax.tree.map(lambda a: a[i], rows)
                 mask, score, first_fail = probe_step(
                     self._ns, self._carry, row, weights, fo,
@@ -664,11 +688,9 @@ class Simulator:
                 ext_msgs: Dict[str, str] = {}   # node -> extender failure msg
                 error: Optional[str] = None
                 error_transient = False
-                for ext in self._extenders:
+                for ext in interested:
                     if not feasible:
                         break
-                    if not ext.is_interested(pod):
-                        continue
                     try:
                         feasible, failed_map = ext.filter(pod, feasible)
                     except ExtenderError as e:
@@ -702,8 +724,8 @@ class Simulator:
                     )
                     continue
                 combined = {n.name: 0.0 for n in feasible}
-                for ext in self._extenders:
-                    if not ext.cfg.prioritize_verb or not ext.is_interested(pod):
+                for ext in interested:
+                    if not ext.cfg.prioritize_verb:
                         continue
                     try:
                         for host, s in ext.prioritize(pod, feasible).items():
@@ -714,11 +736,14 @@ class Simulator:
                         # :529-536 logs and drops them)
                         metrics.EXTENDER_SKIPPED.inc(endpoint=ext.base)
                         log.warning("extender prioritize failed: %s", e)
-                # lowest-node-index tie-break, matching the scan's argmax
+                # lowest-node-index tie-break, matching the scan's argmax.
+                # The combine is f32, mirroring commit_wave's on-device
+                # `score + ext_score` exactly so both paths argmax the same
+                # totals bit-for-bit.
                 name_index = self._name_index_map()
                 best_ni, best_total = -1, -np.inf
                 for j in sorted(name_index[n.name] for n in feasible):
-                    total = float(score_np[j]) + (
+                    total = score_np[j] + np.float32(
                         combined[self.cluster.nodes[j].name]
                         * EXTENDER_SCORE_SCALE
                     )

@@ -14,7 +14,7 @@ over the full capacity sweep, see jaxpr_audit.warm_start_check).
 
 The registry is not a second list to keep in sync: `warmup_registry()`
 replays jaxpr_audit's capture pass, so the warmup set and the audit set
-are the same 16 entries by construction, and a jit entry added without
+are the same 18 entries by construction, and a jit entry added without
 audit coverage fails both gates at once.
 
 Donation interacts cleanly: ``Function.trace`` only needs avals, so

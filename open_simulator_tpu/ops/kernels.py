@@ -1104,6 +1104,109 @@ def commit_step(ns: NodeStatic, carry: Carry, pod: PodRow, node):
 
 
 @sanitizable(
+    "ops.kernels:probe_many", static_argnames=("extra_filters", "extra_scores")
+)
+@functools.partial(jax.jit, static_argnames=("extra_filters", "extra_scores"))
+def probe_many(
+    ns: NodeStatic,
+    carry: Carry,
+    rows: PodRow,
+    weights: jnp.ndarray,
+    filter_on=None,
+    extra_filters=(),
+    extra_scores=(),
+):
+    """probe_step vmapped over a pod-wave axis: filter + score W pods against
+    ONE carry in a single device call — (mask bool[W,N], score f32[W,N] with
+    -inf on infeasible nodes, first_fail i32[W,N]). The extender wave engine
+    probes a whole wave up front so the per-pod HTTP chains can run
+    concurrently; callers pad W to `wave_bucket` (ops/fast.py scenario
+    bucketing discipline) so the jit cache stays at a handful of shapes."""
+
+    def one(pod):
+        mask, first_fail = run_filters(ns, carry, pod, filter_on, extra_filters)
+        score = run_scores(ns, carry, pod, weights, extra_scores)
+        score = jnp.where(mask, score, -jnp.inf)
+        return mask & ns.valid, score, first_fail
+
+    return jax.vmap(one)(rows)
+
+
+@sanitizable(
+    "ops.kernels:commit_wave", static_argnames=("extra_filters", "extra_scores")
+)
+@functools.partial(jax.jit, static_argnames=("extra_filters", "extra_scores"))
+def commit_wave(
+    ns: NodeStatic,
+    carry: Carry,
+    rows: PodRow,
+    weights: jnp.ndarray,
+    expected_mask: jnp.ndarray,
+    expected_ff: jnp.ndarray,
+    ext_allowed: jnp.ndarray,
+    ext_score: jnp.ndarray,
+    want_commit: jnp.ndarray,
+    filter_on=None,
+    extra_filters=(),
+    extra_scores=(),
+):
+    """Pod-order commit scan for one extender wave, with conflict recheck.
+
+    The wave's HTTP filter/prioritize calls were issued against masks probed
+    at the wave-start carry (probe_many). By the time pod i commits, pods
+    0..i-1 of the same wave have already mutated the carry — so each step
+    re-runs the filters against the LIVE carry and compares with the mask the
+    HTTP chain actually saw (`expected_mask`, plus `expected_ff` so failure
+    reasons stay identical). A match proves the serial per-pod path would
+    have issued byte-identical extender requests, so committing
+    argmax(score' + ext_score) here IS the serial placement: score' is
+    recomputed on the live carry, exactly what serial probe_step would have
+    produced at this point. The first mismatch flips a sticky `blocked` flag
+    — that pod and every later pod in the wave respill to the next wave
+    (their serial outcome depends on commits that must land first).
+
+    Inputs per wave lane: `ext_allowed` bool[W,N] nodes surviving the
+    extender filter chain; `ext_score` f32[W,N] combined extender priority ×
+    weight × scale per node (0 elsewhere); `want_commit` bool[W] lanes whose
+    extender chain succeeded with a non-empty feasible set (False = failed /
+    pad lanes, which only recheck). Returns (carry', nodes i32[W] (-1 = no
+    commit), respill bool[W], gpu_take, vg_take, dev_take)."""
+
+    def step(c, xs):
+        carry_c, blocked = c
+        pod, exp_mask, exp_ff, allowed, escore, want = xs
+        mask, first_fail = run_filters(
+            ns, carry_c, pod, filter_on, extra_filters
+        )
+        mask = mask & ns.valid
+        match = jnp.all(mask == exp_mask) & jnp.all(first_fail == exp_ff)
+        respill = blocked | ~match
+        score = run_scores(ns, carry_c, pod, weights, extra_scores)
+        allow = mask & allowed
+        total = jnp.where(allow, score + escore, -jnp.inf)
+        node = jnp.argmax(total)  # first max => lowest node index tie-break
+        ok = want & ~respill & jnp.any(allow) & pod.valid
+        node_out = jnp.where(ok, node, -1).astype(jnp.int32)
+        onehot = (jnp.arange(ns.valid.shape[0]) == node) & ok
+        new_carry, gpu_take, vg_take, dev_take = commit_onehot(
+            ns, carry_c, pod, onehot
+        )
+        return (new_carry, respill), (
+            node_out, respill, gpu_take.astype(jnp.int32), vg_take, dev_take
+        )
+
+    (final_carry, _), (nodes, respill, gpu_take, vg_take, dev_take) = (
+        jax.lax.scan(
+            step,
+            (carry, jnp.bool_(False)),
+            (rows, expected_mask, expected_ff, ext_allowed, ext_score,
+             want_commit),
+        )
+    )
+    return final_carry, nodes, respill, gpu_take, vg_take, dev_take
+
+
+@sanitizable(
     "ops.kernels:schedule_batch",
     static_argnames=("extra_filters", "extra_scores"),
 )

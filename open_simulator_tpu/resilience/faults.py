@@ -49,7 +49,7 @@ import random
 import threading
 import urllib.error
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import yaml
 
@@ -84,6 +84,11 @@ class FaultRule:
     # runtime counters (mutated under the injector lock)
     seen: int = 0
     injected: int = 0
+    # keyed-mode counters (see FaultInjector.intercept): per-key occurrence
+    # and injection counts, so `after`/`times`/`probability` gate per key
+    # instead of per global call order
+    seen_by_key: Dict[str, int] = field(default_factory=dict)
+    injected_by_key: Dict[str, int] = field(default_factory=dict)
 
     def __post_init__(self) -> None:
         if self.target not in TARGETS:
@@ -175,28 +180,110 @@ class FaultPlan:
 class FaultInjector:
     """Evaluates a FaultPlan against intercepted calls. Deterministic: rules
     fire in plan order, per-rule counters gate `after`/`times`, and the one
-    seeded RNG drives `probability` coins in call order."""
+    seeded RNG drives `probability` coins in call order.
+
+    Keyed mode: a call site that passes `key` (the extender transport passes
+    the pod UID) is gated by per-(rule, key) counters and a hash-seeded coin
+    instead of the shared call-order state — so a concurrent wave of calls
+    injects the exact same faults into the exact same pods regardless of
+    thread interleaving. A pod's own calls are temporally ordered (retries
+    are sequential within one chain), so per-key occurrence numbering is
+    deterministic even though cross-pod order is not.
+
+    `snapshot_key`/`restore_key` give a caller that may re-issue a keyed
+    call sequence (the wave engine, after a commit-conflict respill or a
+    discarded speculative dispatch) replay semantics: snapshot the key's
+    occurrence counters before the first dispatch, restore them before any
+    re-issue, and the re-run draws the exact coin positions of its first
+    run — outcomes stay byte-identical to the serial path, which runs the
+    sequence exactly once from the same starting positions (aggregate
+    `injected` counters do count the replay; the per-pod behavior is what
+    determinism is about)."""
 
     def __init__(self, plan: FaultPlan) -> None:
         self.plan = plan
         self.rng = random.Random(plan.seed)
         self._lock = threading.Lock()
 
-    def intercept(self, target: str, op: str = "") -> Optional[FaultRule]:
+    def _match_ordered(self, rule: FaultRule) -> bool:
+        """Legacy gating: global call-order counters + the shared RNG."""
+        rule.seen += 1
+        if rule.seen <= rule.after:
+            return False
+        if rule.times is not None and rule.injected >= rule.times:
+            return False
+        if rule.probability < 1.0 and self.rng.random() >= rule.probability:
+            return False
+        rule.injected += 1
+        return True
+
+    def _match_keyed(self, rule: FaultRule, idx: int, key: str) -> bool:
+        """Keyed gating: `after`/`times` count this key's own calls, and the
+        probability coin is a pure function of (seed, rule, key, occurrence)
+        — byte-deterministic under any cross-key interleaving."""
+        seen = rule.seen_by_key.get(key, 0) + 1
+        rule.seen_by_key[key] = seen
+        rule.seen += 1
+        if seen <= rule.after:
+            return False
+        if rule.times is not None and (
+            rule.injected_by_key.get(key, 0) >= rule.times
+        ):
+            return False
+        if rule.probability < 1.0:
+            coin = random.Random(
+                f"{self.plan.seed}|{idx}|{key}|{seen}"
+            ).random()
+            if coin >= rule.probability:
+                return False
+        rule.injected_by_key[key] = rule.injected_by_key.get(key, 0) + 1
+        rule.injected += 1
+        return True
+
+    def snapshot_key(self, key: str) -> List[Tuple[int, int]]:
+        """Per-rule (seen, injected) counters for `key`, in plan order —
+        taken before a keyed sequence's first dispatch (see class
+        docstring)."""
         with self._lock:
-            for rule in self.plan.rules:
+            return [
+                (
+                    rule.seen_by_key.get(key, 0),
+                    rule.injected_by_key.get(key, 0),
+                )
+                for rule in self.plan.rules
+            ]
+
+    def restore_key(self, key: str, snap: List[Tuple[int, int]]) -> None:
+        """Rewind `key`'s counters to a snapshot so a re-issued sequence
+        replays its first run's coin positions. The aggregate per-rule
+        `seen`/`injected` counters are deliberately not rewound."""
+        with self._lock:
+            for rule, (seen, injected) in zip(self.plan.rules, snap):
+                if seen:
+                    rule.seen_by_key[key] = seen
+                else:
+                    rule.seen_by_key.pop(key, None)
+                if injected:
+                    rule.injected_by_key[key] = injected
+                else:
+                    rule.injected_by_key.pop(key, None)
+
+    def intercept(
+        self, target: str, op: str = "", key: str = ""
+    ) -> Optional[FaultRule]:
+        with self._lock:
+            for idx, rule in enumerate(self.plan.rules):
                 if rule.target != target:
                     continue
                 if rule.op and rule.op not in op:
                     continue
-                rule.seen += 1
-                if rule.seen <= rule.after:
+                matched = (
+                    self._match_keyed(rule, idx, key)
+                    if key
+                    else self._match_ordered(rule)
+                )
+                if not matched:
                     continue
-                if rule.times is not None and rule.injected >= rule.times:
-                    continue
-                if rule.probability < 1.0 and self.rng.random() >= rule.probability:
-                    continue
-                rule.injected += 1
                 metrics.FAULTS_INJECTED.inc(target=target, kind=rule.kind)
                 return rule
         return None
@@ -239,11 +326,29 @@ def active_injector() -> Optional[FaultInjector]:
     return _active
 
 
-def maybe_inject(target: str, op: str = "") -> Optional[FaultRule]:
+def maybe_inject(
+    target: str, op: str = "", key: str = ""
+) -> Optional[FaultRule]:
     inj = _active
     if inj is None:
         return None
-    return inj.intercept(target, op)
+    return inj.intercept(target, op, key=key)
+
+
+def snapshot_key(key: str) -> Optional[List[Tuple[int, int]]]:
+    """Snapshot `key`'s fault counters (None with no active plan)."""
+    inj = _active
+    return None if inj is None else inj.snapshot_key(key)
+
+
+def restore_key(key: str, snap: Optional[List[Tuple[int, int]]]) -> None:
+    """Rewind `key`'s counters to `snap` before re-issuing its sequence
+    (no-op with no active plan or a None snapshot)."""
+    if snap is None:
+        return
+    inj = _active
+    if inj is not None:
+        inj.restore_key(key, snap)
 
 
 class injected:

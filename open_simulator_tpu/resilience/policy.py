@@ -80,6 +80,13 @@ class RetryPolicy:
     rng: random.Random = field(default_factory=lambda: random.Random(0))
     clock: Callable[[], float] = time.monotonic
     sleep: Callable[[float], None] = time.sleep
+    # random.Random.uniform is a read-modify-write of hidden generator state;
+    # the wave engine shares one policy across its HTTP worker threads, so
+    # jitter draws must serialize (execute() itself keeps all other state in
+    # locals). Excluded from comparison: a Lock carries no policy identity.
+    _rng_lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
 
     @staticmethod
     def from_env(
@@ -101,10 +108,12 @@ class RetryPolicy:
         )
 
     def next_delay(self, prev_delay: float) -> float:
-        """One decorrelated-jitter step: uniform(base, 3 × prev), capped."""
+        """One decorrelated-jitter step: uniform(base, 3 × prev), capped.
+        Thread-safe: the shared RNG draw serializes under `_rng_lock`."""
         lo = self.base_s
         hi = max(lo, prev_delay * 3.0)
-        return min(self.cap_s, self.rng.uniform(lo, hi))
+        with self._rng_lock:
+            return min(self.cap_s, self.rng.uniform(lo, hi))
 
     def _attempt_timeout(self, start: float) -> Optional[float]:
         timeout = self.per_attempt_timeout_s

@@ -433,8 +433,24 @@ EXTENDER_REQUESTS = REGISTRY.counter(
 )
 EXTENDER_DURATION = REGISTRY.histogram(
     "osim_extender_duration_seconds",
-    "HTTP scheduler-extender round-trip duration, seconds.",
-    labelnames=("verb",),
+    "HTTP scheduler-extender round-trip duration, seconds, by verb and "
+    "outcome (ok / error / circuit_open) — error and fail-fast paths cost "
+    "real wall time too.",
+    labelnames=("verb", "outcome"),
+)
+EXTENDER_INFLIGHT = REGISTRY.gauge(
+    "osim_extender_inflight",
+    "Per-pod extender HTTP chains currently in flight in the wave engine.",
+)
+EXTENDER_WAVE_SIZE = REGISTRY.histogram(
+    "osim_extender_wave_size",
+    "Pods per dispatched extender wave (real lanes, excluding bucket pad).",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
+)
+EXTENDER_WAVE_RESPILL = REGISTRY.counter(
+    "osim_extender_wave_respill_total",
+    "Wave pods respilled to the next wave after the commit-time feasibility "
+    "recheck saw a mask changed by earlier commits.",
 )
 HTTP_REQUESTS = REGISTRY.counter(
     "osim_http_requests_total",

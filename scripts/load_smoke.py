@@ -19,7 +19,14 @@ depth 4 and asserts the ISSUE-7 overload contract end to end:
   5. (async jobs) POST /v1/jobs runs a journaled capacity sweep to
      completion, GET /v1/jobs/<id> streams its sweep progress records,
      and a resume re-POST replays the journal to a byte-identical
-     outcome.json instead of recomputing.
+     outcome.json instead of recomputing;
+  6. (extender wave) a 500-pod apply through the wave-pipelined extender
+     engine against an example HTTP extender server answers every filter
+     and prioritize round trip 200 (zero 5xx served, zero error/circuit
+     outcomes recorded), and placements are identical to a rerun under
+     the escape hatch `OSIM_EXTENDER_WAVE=0` (the serial per-pod loop;
+     `OSIM_EXTENDER_KEEPALIVE=0` further reverts the transport — see
+     docs/performance.md).
 
 Runs on CPU in-process; exits nonzero with a labeled failure otherwise.
 """
@@ -374,7 +381,175 @@ def _jobs_smoke():
             os.environ["OSIM_RUNS_DIR"] = prior
 
 
-def _publish_summary(n_ok, n_shed, sat, jobs):
+def _extender_smoke(n_pods=500, n_nodes=50):
+    """Section 6: the wave-pipelined extender engine under load. An example
+    scheduler-extender server (pass-through filter + prioritize, the shape
+    a real deployment would run out of process) serves a 500-pod apply
+    through the default wave pipeline, then the same apply reruns under
+    the escape hatch `OSIM_EXTENDER_WAVE=0` (serial per-pod loop). The
+    server must have answered every round trip 200 — zero 5xx — the
+    engine must have recorded zero error/circuit_open outcomes, and the
+    two placement multisets must be identical."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from open_simulator_tpu.engine.simulator import (
+        AppResource,
+        ClusterResource,
+        simulate,
+    )
+    from open_simulator_tpu.core.objects import Node
+    from open_simulator_tpu.models.profiles import ExtenderConfig
+    from open_simulator_tpu.utils import httppool
+
+    served = []  # status codes the example server answered with
+
+    class ExampleExtender(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def do_POST(self):
+            body = json.loads(
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                or b"{}"
+            )
+            names = body.get("NodeNames") or []
+            if self.path.endswith("/filter"):
+                resp = {"NodeNames": names, "FailedNodes": {}, "Error": ""}
+            elif self.path.endswith("/prioritize"):
+                resp = [{"Host": n, "Score": 5} for n in names]
+            else:
+                served.append(404)
+                self.send_error(404)
+                return
+            payload = json.dumps(resp).encode()
+            served.append(200)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *args):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), ExampleExtender)
+    srv.daemon_threads = True
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    cfg = ExtenderConfig(
+        url_prefix=f"http://127.0.0.1:{port}",
+        filter_verb="filter",
+        prioritize_verb="prioritize",
+        node_cache_capable=True,
+    )
+    res = {"cpu": "16", "memory": "64Gi", "pods": "110"}
+    deploy = {
+        "kind": "Deployment",
+        "metadata": {"name": "ext-smoke", "namespace": "smoke"},
+        "spec": {
+            "replicas": n_pods,
+            "template": {
+                "metadata": {"labels": {"app": "ext-smoke"}},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "c",
+                            "image": "img",
+                            "resources": {
+                                "requests": {"cpu": "500m", "memory": "1Gi"}
+                            },
+                        }
+                    ]
+                },
+            },
+        },
+    }
+
+    def err_outcomes():
+        return sum(
+            s["value"]
+            for s in metrics.EXTENDER_REQUESTS.snapshot()["samples"]
+            if s["labels"].get("outcome") != "ok"
+        )
+
+    def leg(wave_env):
+        prior = os.environ.get("OSIM_EXTENDER_WAVE")
+        if wave_env is None:
+            os.environ.pop("OSIM_EXTENDER_WAVE", None)
+        else:
+            os.environ["OSIM_EXTENDER_WAVE"] = wave_env
+        try:
+            nodes = [
+                Node.from_dict(
+                    {
+                        "metadata": {
+                            "name": f"ext-n-{i}",
+                            "labels": {"kubernetes.io/hostname": f"ext-n-{i}"},
+                        },
+                        "status": {
+                            "allocatable": dict(res), "capacity": dict(res),
+                        },
+                    }
+                )
+                for i in range(n_nodes)
+            ]
+            apps = [AppResource(name="smoke", objects=[dict(deploy)])]
+            result = simulate(
+                ClusterResource(nodes=nodes), apps, extenders=[cfg]
+            )
+        finally:
+            if prior is None:
+                os.environ.pop("OSIM_EXTENDER_WAVE", None)
+            else:
+                os.environ["OSIM_EXTENDER_WAVE"] = prior
+            httppool.reset_pools()  # no warm sockets leak across legs
+        placements = sorted(
+            (
+                p.meta.annotations.get("simon/workload-name", ""),
+                st.node.name,
+            )
+            for st in result.node_status
+            for p in st.pods
+        )
+        return placements, len(result.unscheduled)
+
+    err0 = err_outcomes()
+    try:
+        wave_placed, wave_unsched = leg(None)  # default: wave pipeline
+        serial_placed, _ = leg("0")            # escape hatch: serial loop
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+    bad = sorted({c for c in served if c != 200})
+    if bad:
+        fail(f"extender server answered non-200 statuses {bad} (zero 5xx)")
+    if err_outcomes() != err0:
+        fail("extender engine recorded error/circuit_open outcomes")
+    if len(wave_placed) != n_pods or wave_unsched:
+        fail(
+            f"extender apply placed {len(wave_placed)}/{n_pods} pods "
+            f"({wave_unsched} unscheduled)"
+        )
+    if wave_placed != serial_placed:
+        fail(
+            "wave placements diverge from OSIM_EXTENDER_WAVE=0 "
+            "(escape-hatch byte-identity contract broken)"
+        )
+    print(
+        f"extender OK: {n_pods} pods through the wave pipeline, "
+        f"{len(served)} round trips all 200, placements identical to "
+        f"OSIM_EXTENDER_WAVE=0"
+    )
+    return {
+        "pods": n_pods,
+        "round_trips": len(served),
+        "non_200": 0,
+        "identical_to_serial": True,
+    }
+
+
+def _publish_summary(n_ok, n_shed, sat, jobs, ext):
     """Append the human-readable result to the CI job summary when GitHub
     provides one (GITHUB_STEP_SUMMARY); silently a no-op locally."""
     path = os.environ.get("GITHUB_STEP_SUMMARY")
@@ -391,6 +566,9 @@ def _publish_summary(n_ok, n_shed, sat, jobs):
         f"- async job `{jobs['job']}`: {jobs['sweep_records']} sweep "
         f"progress records, nodes_added={jobs['nodes_added']}, "
         f"resume replay byte-identical",
+        f"- extender wave: {ext['pods']} pods, {ext['round_trips']} "
+        f"round trips all 200, placements identical to "
+        f"`OSIM_EXTENDER_WAVE=0`",
         "",
     ]
     with open(path, "a") as fh:
@@ -527,7 +705,10 @@ def main():
     # --- 5: async jobs — journaled capacity sweep over /v1/jobs ------------
     jobs = _jobs_smoke()
 
-    _publish_summary(n_ok, n_shed, sat, jobs)
+    # --- 6: extender wave pipeline vs the OSIM_EXTENDER_WAVE=0 hatch -------
+    ext = _extender_smoke()
+
+    _publish_summary(n_ok, n_shed, sat, jobs, ext)
     print(
         json.dumps(
             {
@@ -538,6 +719,7 @@ def main():
                 "dropped": 0,
                 "saturation": sat,
                 "jobs": jobs,
+                "extender": ext,
             }
         )
     )

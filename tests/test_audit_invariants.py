@@ -176,7 +176,7 @@ def test_fixture_findings_are_deterministic_json():
 
 
 # ---------------------------------------------------------------------------
-# tier-1 gate: the production kernels prove clean, all 16 entries covered
+# tier-1 gate: the production kernels prove clean, all 18 entries covered
 # ---------------------------------------------------------------------------
 
 @pytest.fixture(scope="module")
@@ -190,7 +190,7 @@ def test_all_registered_entries_prove_clean(audit):
 
     proved = {e.entry for e in audit.entries}
     assert proved == set(REQUIRED_COVERAGE)
-    assert len(proved) == 16
+    assert len(proved) == 18
 
 
 def test_mask_outputs_proved_binary(audit):
