@@ -1468,6 +1468,182 @@ def config_resident_delta_10k(n_nodes=10_000, n_deltas=30, touched=8):
     return out
 
 
+def _hetero_template(name="new-node"):
+    """A realistic heterogeneous capacity template: zone/instance-type
+    labels, a taint, GPUs, open-local storage — the loop encode pays every
+    axis it would pay in production, so the stamped-vs-loop ratio is the
+    honest one."""
+    import json as _json
+
+    from open_simulator_tpu.core.objects import ANNO_NODE_LOCAL_STORAGE
+
+    GiB = 1 << 30
+    template = _mk_node(
+        name, "32", "64Gi",
+        labels={
+            "topology.kubernetes.io/zone": "az-1",
+            "node.kubernetes.io/instance-type": "ecs.gn7.8xlarge",
+            "disk": "ssd",
+        },
+        capacity_extra={
+            "alibabacloud.com/gpu-count": "4",
+            "alibabacloud.com/gpu-mem": f"{4 * 16384}Mi",
+        },
+    )
+    template.meta.annotations[ANNO_NODE_LOCAL_STORAGE] = _json.dumps(
+        {
+            "vgs": [{"name": "vg-open", "capacity": str(400 * GiB),
+                     "requested": str(40 * GiB)}],
+            "devices": [{"name": "sdb", "device": "/dev/sdb",
+                         "capacity": str(200 * GiB), "mediaType": "ssd",
+                         "isAllocated": False}],
+        }
+    )
+    return template
+
+
+def _config_plan_scaled(n_pods, n_nodes):
+    """Million-scale node axis (docs/performance.md, node-bucket ladder):
+    one segment publishing the four acceptance numbers together —
+
+      - stamped-vs-loop encode wall at n_nodes clones, byte-identity
+        asserted on every NodeTable array (floor: 10x);
+      - full capacity plan pods/s at (n_pods, n_nodes) scale;
+      - distinct compiled scenario programs, all on ladder rungs;
+      - per-device HBM bytes for the node-sharded vs replicated table
+        (>= 2 devices; sharded must be strictly smaller)."""
+    import numpy as np
+
+    from open_simulator_tpu.engine.capacity import new_fake_nodes, plan_capacity
+    from open_simulator_tpu.engine.simulator import (
+        AppResource,
+        ClusterResource,
+    )
+    from open_simulator_tpu.ops.encode import (
+        _STAMP_FIELDS,
+        Encoder,
+        encode_nodes,
+        node_bucket,
+    )
+    from open_simulator_tpu.ops.fast import (
+        reset_scenario_programs,
+        scenario_programs,
+    )
+
+    out = {}
+
+    # --- template-stamped encode: loop vs stamped at n_nodes clones -------
+    clones = new_fake_nodes(_hetero_template(), n_nodes)
+    t0 = time.time()
+    t_loop = encode_nodes(Encoder(), clones, stamp=False)
+    loop_s = time.time() - t0
+    stamped_s = float("inf")
+    enc_stamp = None
+    for _ in range(3):
+        enc = Encoder()
+        t0 = time.time()
+        t_stamp = encode_nodes(enc, clones, stamp=True)
+        if time.time() - t0 < stamped_s:
+            stamped_s = time.time() - t0
+            enc_stamp = enc
+    byte_identical = all(
+        np.asarray(getattr(t_loop, f)).tobytes()
+        == np.asarray(getattr(t_stamp, f)).tobytes()
+        for f in _STAMP_FIELDS
+    ) and t_loop.names == t_stamp.names
+    speedup = loop_s / stamped_s if stamped_s > 0 else None
+    out["encode_loop_ms"] = round(1000 * loop_s, 1)
+    out["encode_stamped_ms"] = round(1000 * stamped_s, 1)
+    out["encode_stamped_speedup"] = round(speedup, 1) if speedup else None
+    out["encode_byte_identical"] = bool(byte_identical)
+    if not byte_identical:
+        out["error"] = "stamped encode is not byte-identical to loop encode"
+    elif speedup is not None and speedup < 10:
+        out["error"] = (
+            f"stamped encode only {speedup:.1f}x faster than loop "
+            "(acceptance floor is 10x)"
+        )
+
+    # --- per-device HBM: node-sharded vs replicated table -----------------
+    import jax
+
+    if len(jax.devices()) >= 2:
+        from open_simulator_tpu.ops.state import node_static_from_table
+        from open_simulator_tpu.parallel.mesh import (
+            hbm_bytes_per_device,
+            node_sharding,
+            product_mesh_2d,
+            replicated,
+        )
+
+        mesh = product_mesh_2d(1, len(jax.devices()))
+        ns = node_static_from_table(enc_stamp, t_stamp)
+        rep = hbm_bytes_per_device(jax.device_put(ns, replicated(mesh, ns)))
+        shd = hbm_bytes_per_device(jax.device_put(ns, node_sharding(mesh)))
+        out["hbm_bytes_per_device_replicated"] = max(rep.values())
+        out["hbm_bytes_per_device_sharded"] = max(shd.values())
+        if max(shd.values()) >= max(rep.values()):
+            out["error"] = out.get("error") or (
+                "node-sharded table not smaller per device than replicated"
+            )
+        del ns
+
+    # --- full capacity plan at (n_pods, n_nodes) scale --------------------
+    # Sized like plan_100k_10k: the workload genuinely overflows (~0.375
+    # cpu/pod demand vs 3 cpu/node supply) so the add-node search runs. No
+    # spread constraint here — spread chunks the commit scan at every skew
+    # boundary (plan_100k_10k covers that at scale); these segments measure
+    # raw plan throughput on the node-bucket ladder, where whole
+    # deployments batch through the group fast path.
+    nodes = [
+        _mk_node(
+            f"n-{i}", "3", "6Gi",
+            labels={"topology.kubernetes.io/zone": f"az-{i % 3}"},
+        )
+        for i in range(n_nodes)
+    ]
+    deploys = [
+        _mk_deploy("web", n_pods // 2, "500m", "1Gi"),
+        _mk_deploy("batch", n_pods - n_pods // 2, "250m", "512Mi"),
+    ]
+    template = _mk_node("new-node", "32", "64Gi")
+    reset_scenario_programs()
+    t0 = time.time()
+    plan = plan_capacity(
+        ClusterResource(nodes=nodes),
+        [AppResource(name="bench", objects=deploys)],
+        template,
+    )
+    wall = time.time() - t0
+    out["wall_s"] = round(wall + loop_s + 3 * stamped_s, 2)
+    out["plan_wall_s"] = round(wall, 2)
+    out["value"] = round(n_pods / wall, 1)
+    out["unit"] = "pods/s"
+    out["nodes_added"] = plan.nodes_added if plan else -1
+    out["attempts"] = plan.attempts if plan else 0
+    out["batched_calls"] = plan.batched_calls if plan else 0
+
+    # --- distinct programs: every one on a ladder rung --------------------
+    progs = scenario_programs()
+    out["distinct_programs"] = sum(len(p) for p in progs.values())
+    out["ladder_rungs_touched"] = sorted({n for (n, _p) in progs})
+    off = [n for (n, _p) in progs if node_bucket(n) != n]
+    if off:
+        out["error"] = out.get("error") or f"off-ladder node paddings: {off}"
+    return out
+
+
+def config_plan_200k_20k():
+    """CPU-scaled million-node segment: 200k pods / 20k nodes (CI publishes
+    this one; plan_1m_100k is the full-scale variant)."""
+    return _config_plan_scaled(200_000, 20_000)
+
+
+def config_plan_1m_100k():
+    """The full million-scale segment: 1M pods / 100k nodes."""
+    return _config_plan_scaled(1_000_000, 100_000)
+
+
 CONFIGS = {
     "stock": config_stock,
     "fit_1k_100n": config_fit,
@@ -1484,7 +1660,13 @@ CONFIGS = {
     "serving_concurrent": config_serving_concurrent,
     "serving_saturation": config_serving_saturation,
     "resident_delta_10k": config_resident_delta_10k,
+    "plan_200k_20k": config_plan_200k_20k,
+    "plan_1m_100k": config_plan_1m_100k,
 }
+
+# Excluded from `--configs all`: run them by name (CI runs plan_200k_20k
+# on its own schedule; plan_1m_100k is the full-scale local run).
+SLOW_CONFIGS = {"plan_200k_20k", "plan_1m_100k"}
 
 
 def _fmt_count(n: int) -> str:
@@ -1603,6 +1785,12 @@ SEGMENT_TIMEOUT_S = {
     "serving_concurrent": 600.0,
     "serving_saturation": 900.0,
     "resident_delta_10k": 900.0,
+    # The scaled plan segments run the default batched sweep, which commits
+    # per-pod (no group fast path inside schedule_scenarios yet): on a CPU
+    # host they are wall-hours, which is why they sit in SLOW_CONFIGS and
+    # CI runs plan_200k_20k in its own push-only job.
+    "plan_200k_20k": 7200.0,
+    "plan_1m_100k": 14400.0,
 }
 
 
@@ -1643,11 +1831,12 @@ def _run_segment(name: str, pods: int, nodes: int, platform: str) -> dict:
     env = dict(os.environ)
     if platform:
         env["JAX_PLATFORMS"] = platform
-    if name == "sharded_2dev_smoke":
-        # the sharding-equivalence smoke needs >=2 devices on every CI
-        # lane: provision 2 virtual CPU devices (the flag only affects the
-        # host platform, so this segment is deliberately CPU-pinned — it
-        # proves placement equivalence, not device speed)
+    if name in ("sharded_2dev_smoke", "plan_200k_20k", "plan_1m_100k"):
+        # these segments need >=2 devices on every CI lane (the sharding
+        # smoke proves placement equivalence; the plan segments report
+        # per-device HBM for the node-sharded vs replicated table):
+        # provision 2 virtual CPU devices — the flag only affects the host
+        # platform, so they are deliberately CPU-pinned
         env["JAX_PLATFORMS"] = "cpu"
         env["XLA_FLAGS"] = (
             env.get("XLA_FLAGS", "")
@@ -1692,7 +1881,9 @@ def main() -> int:
     parser.add_argument(
         "--configs", default="all",
         help="comma list of end-to-end configs to run alongside the headline "
-        f"kernel benchmark ({', '.join(CONFIGS)}), 'all', or 'none'",
+        f"kernel benchmark ({', '.join(CONFIGS)}), 'all', or 'none'; "
+        f"'all' skips the slow configs ({', '.join(sorted(SLOW_CONFIGS))}) — "
+        "name them explicitly to run them",
     )
     parser.add_argument(
         "--segment", default="",
@@ -1747,7 +1938,8 @@ def main() -> int:
 
     # Validate --configs up front so a typo fails fast even with --quick.
     if args.configs in ("none", "all"):
-        wanted = [] if args.configs == "none" else list(CONFIGS)
+        wanted = ([] if args.configs == "none"
+                  else [c for c in CONFIGS if c not in SLOW_CONFIGS])
     else:
         wanted = [c.strip() for c in args.configs.split(",") if c.strip()]
         unknown = [c for c in wanted if c not in CONFIGS]

@@ -6,7 +6,7 @@ canonical bucketed shapes the jaxpr auditor traces:
 
   * masks stay {0,1}-valued (bool dtype all the way to the entry outputs),
   * every score plugin lands in [0,100] (kube's checkPluginScores contract),
-  * no float output of any of the 13 jit entries can be NaN, and
+  * no float output of any registered jit entry can be NaN, and
   * the deliberate ``-inf * 0.0 → NaN`` sentinel pattern (fast.py's score
     lanes carry -inf on infeasible nodes) can never reach a selection point
     — argmax/argmin/reduce_max/reduce_min/sort operands are proven NaN-free.
@@ -840,7 +840,7 @@ _RULES: Dict[str, Callable] = {
 
 
 # ---------------------------------------------------------------------------
-# Entry-tier audit: the 12 jit entries on canonical shapes
+# Entry-tier audit: every registered jit entry on canonical shapes
 # ---------------------------------------------------------------------------
 
 @dataclasses.dataclass
@@ -1041,9 +1041,9 @@ class InvariantAudit:
 
 
 def run_invariants() -> InvariantAudit:
-    """Retrace the 13 canonical jit entries + the 10 score plugins and
-    abstractly interpret every jaxpr. Deterministic given the canonical
-    state (the same one the jaxpr auditor uses)."""
+    """Retrace every canonical jit entry (jaxpr_audit.AUDIT_TARGETS) + the
+    10 score plugins and abstractly interpret every jaxpr. Deterministic
+    given the canonical state (the same one the jaxpr auditor uses)."""
     from . import jaxpr_audit as ja
 
     captured = ja._capture_calls()

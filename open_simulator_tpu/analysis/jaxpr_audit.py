@@ -3,7 +3,8 @@
 The AST lint (``analysis.lint``) catches what syntax shows; this layer
 catches what only tracing shows. It builds one canonical encoded state —
 a small synthetic cluster at the same bucket family production uses
-(``round_up(n_nodes, 64)`` node axis, ``_bucket``-padded pod groups) —
+(``node_bucket(n_nodes)`` ladder node axis, ``_bucket``-padded pod
+groups) —
 runs the real host dispatchers over it while *capturing* every jit-entry
 call, then retraces each captured call with ``Function.trace`` and walks
 the jaxpr:
@@ -580,6 +581,8 @@ class GuardResult:
     scenario_programs: Dict[str, List[int]] = dataclasses.field(
         default_factory=dict
     )
+    #: distinct node-axis paddings the sweep's batched programs compiled for
+    ladder_rungs: List[int] = dataclasses.field(default_factory=list)
 
     @property
     def scenario_ok(self) -> bool:
@@ -589,11 +592,22 @@ class GuardResult:
         )
 
     @property
+    def ladder_ok(self) -> bool:
+        """Every batched program's node axis sits exactly on a ladder rung
+        (ops.encode.node_bucket is idempotent on it): the sweep never
+        compiled an off-ladder node shape, so a growing search compiles at
+        most SCENARIO_PROGRAMS_PER_BUCKET programs per rung it touches."""
+        from ..ops.encode import node_bucket
+
+        return all(node_bucket(n) == n for n in self.ladder_rungs)
+
+    @property
     def ok(self) -> bool:
         return (
             0 < self.compiles <= self.budget
             and self.compiles == self.metric_compiles
             and self.scenario_ok
+            and self.ladder_ok
         )
 
     def to_dict(self) -> dict:
@@ -608,6 +622,8 @@ class GuardResult:
             "batched_nodes_added": self.batched_nodes_added,
             "scenario_programs": self.scenario_programs,
             "scenario_ok": self.scenario_ok,
+            "ladder_rungs": self.ladder_rungs,
+            "ladder_ok": self.ladder_ok,
         }
 
     def render_text(self) -> str:
@@ -621,7 +637,9 @@ class GuardResult:
             f"sweep adding {self.nodes_added} node(s) in {self.attempts} "
             f"probes; batched sweep: {self.batched_calls} call(s), "
             f"{worst} scenario program(s)/bucket "
-            f"(max {SCENARIO_PROGRAMS_PER_BUCKET}), answer "
+            f"(max {SCENARIO_PROGRAMS_PER_BUCKET}), node rungs "
+            f"{self.ladder_rungs} "
+            f"{'on-ladder' if self.ladder_ok else 'OFF-LADDER'}, answer "
             f"{'agrees' if self.batched_nodes_added == self.nodes_added else 'DISAGREES'}"
         )
 
@@ -756,6 +774,7 @@ def run_recompile_guard(budget: int = RECOMPILE_BUDGET) -> GuardResult:
             f"{n}x{p}": sorted(pads)
             for (n, p), pads in scenario_programs().items()
         },
+        ladder_rungs=sorted({n for (n, _p) in scenario_programs()}),
     )
 
 

@@ -649,7 +649,7 @@ def _add_warmup(sub: argparse._SubParsersAction) -> None:
         "compilation cache",
         description=(
             "Compile lifecycle as a phase, not a side effect: enumerate "
-            "the audited jit entries (the same 16 the jaxpr audit proves "
+            "the audited jit entries (the same set the jaxpr audit proves "
             "over) at their canonical bucketed shapes, drive each through "
             "trace().lower().compile(), and rehearse the full capacity "
             "sweep so every program the engine needs lands in the "
@@ -667,8 +667,8 @@ def _add_warmup(sub: argparse._SubParsersAction) -> None:
     p.add_argument(
         "--no-sweep", action="store_true",
         help="skip the capacity-sweep rehearsal (registry entries only; "
-        "the zero-cold-compile guarantee then covers only the 16 audited "
-        "programs)",
+        "the zero-cold-compile guarantee then covers only the audited "
+        "registry programs)",
     )
     p.add_argument(
         "--check", action="store_true",

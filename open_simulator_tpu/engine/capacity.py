@@ -43,19 +43,102 @@ from .simulator import (
 LADDER_LANES = 8
 SWEEP_LANES = 8
 
-def new_fake_nodes(template: Node, count: int) -> List[Node]:
-    """Clone the candidate node `count` times as simon-NNNNN with the new-node
-    label (parity: utils.NewFakeNodes, utils.go:885-915 — the reference uses
-    random 5-char suffixes; we use ordinals so names are guaranteed unique at
-    any count and identical across capacity-search probes)."""
+def new_fake_nodes(template: Node, count: int, start: int = 0) -> List[Node]:
+    """Clone the candidate node `count - start` times as simon-NNNNN (ordinals
+    start..count-1) with the new-node label (parity: utils.NewFakeNodes,
+    utils.go:885-915 — the reference uses random 5-char suffixes; we use
+    ordinals so names are guaranteed unique at any count and identical across
+    capacity-search probes). `start` lets the batched sweep's trial cache
+    extend an existing clone list without re-deepcopying the prefix.
+
+    Every clone carries a shared `_stamp_token`: identity keying for the
+    template-stamping encode (ops.encode), in the spirit of _pod_row_sig's
+    id() keys — clones of one template are content-equal except name and
+    hostname BY CONSTRUCTION (this deepcopy), so the encoder can group them
+    without recomputing content signatures. The token is minted once per
+    template object and must only ever be set on fresh deepcopies; code
+    that mutates a clone's labels or taints afterwards must delete the
+    attribute (unschedulable/storage/usage drift is already covered by the
+    encoder's token signature)."""
+    token = template.__dict__.get("_clone_token")
+    if token is None:
+        token = object()
+        template.__dict__["_clone_token"] = token
     out = []
-    for i in range(count):
+    for i in range(start, count):
         node = copy.deepcopy(template)
         node.meta.name = f"simon-{i:05d}"
         node.meta.labels["kubernetes.io/hostname"] = node.meta.name
         node.meta.labels[LABEL_NEW_NODE] = "true"
+        node.__dict__.pop("_clone_token", None)  # don't inherit minting state
+        node.__dict__["_stamp_token"] = token
         out.append(node)
     return out
+
+
+class _TrialReuse:
+    """Per-plan reuse of the batched sweep's trial state across device calls.
+
+    Two layers. (1) Fake-node clones are deepcopied once and grown
+    incrementally (`fakes`) instead of re-cloned per sweep. (2) Within a
+    ladder rung (same n_pad bucket, see ops.encode.node_bucket), the previous
+    sweep's encoder and node table are reused: a sweep needing fewer clones
+    clears the surplus rows back to pad values, one needing more encodes only
+    the new rows — clear_node_row/encode_node_into deltas, never a full
+    re-encode. Crossing a rung drops the cache (the table's node axis must be
+    reallocated), and the fresh encode is template-stamped, so even that is
+    cheap. Rows are byte-identical to a from-scratch encode by construction
+    (clear_node_row resets to exactly the pad values encode_nodes allocates);
+    the shared encoder keeps ids consistent for every lane."""
+
+    def __init__(self, template: Node, n_base: int) -> None:
+        self._template = template
+        self.n_base = n_base
+        self._fakes: List[Node] = []
+        self.enc = None
+        self.table = None
+        self.encoded = 0  # real rows currently encoded (n_base + clones)
+        self.n_pad = 0
+        self.rungs_touched: set = set()
+
+    def fakes(self, count: int) -> List[Node]:
+        if count > len(self._fakes):
+            self._fakes.extend(
+                new_fake_nodes(self._template, count, start=len(self._fakes))
+            )
+        return self._fakes[:count]
+
+    def preencoded(self, max_count: int, n_pad: int):
+        """(enc, table) delta-updated for a trial of n_base + max_count
+        nodes at this rung, or None when the rung changed (full re-encode)."""
+        from ..ops.encode import clear_node_row, encode_node_into
+
+        if self.table is None or n_pad != self.n_pad:
+            return None
+        want = self.n_base + max_count
+        if want > n_pad:
+            return None
+        table = self.table
+        if want < self.encoded:
+            for i in range(want, self.encoded):
+                clear_node_row(table, i)
+            del table.names[want:]
+        elif want > self.encoded:
+            grown = self.fakes(max_count)[self.encoded - self.n_base:]
+            for i, nd in enumerate(grown, start=self.encoded):
+                clear_node_row(table, i)
+                encode_node_into(self.enc, table, i, nd, {}, {})
+                table.names.append(nd.name)
+        self.encoded = want
+        return self.enc, table
+
+    def capture(self, sim, n_real: int, n_pad: int) -> None:
+        if sim._table is None:
+            return
+        self.enc = sim.enc
+        self.table = sim._table
+        self.encoded = n_real
+        self.n_pad = n_pad
 
 
 def max_resource_limits() -> Tuple[float, float]:
@@ -253,7 +336,7 @@ def plan_capacity(
     `trial` records force serial, regardless of `sweep_mode`."""
 
     from ..durable.watchdog import call_deadline_s, guarded_call
-    from ..ops.encode import round_up
+    from ..ops.encode import node_bucket
     from ..resilience.policy import RetryExhaustedError, RetryPolicy
     from ..utils.tracing import log
 
@@ -427,6 +510,12 @@ def plan_capacity(
                 )
         return res
 
+    # Trial-state reuse for the batched sweeps: fake clones deepcopied once,
+    # and within a ladder rung the previous sweep's encoder + node table are
+    # delta-updated instead of re-encoded (verdict mode never mutates node
+    # objects, so the rows stay truthful across device calls).
+    reuse = _TrialReuse(new_node, n_base)
+
     def sweep(counts: List[int], n_pad_sweep: int, phase: str):
         """One batched device call — verdicts for a whole ladder of node
         counts at once — or its journal replay on resume. Each lane k is the
@@ -454,7 +543,7 @@ def plan_capacity(
             )
             sweep_cache.clear()
         trial = ClusterResource(
-            nodes=list(cluster.nodes) + new_fake_nodes(new_node, max(counts)),
+            nodes=list(cluster.nodes) + reuse.fakes(max(counts)),
             pods=list(cluster.pods),
             daemonsets=list(cluster.daemonsets),
             others=dict(cluster.others),
@@ -463,18 +552,26 @@ def plan_capacity(
             Scenario(name=f"+{k}", node_count=n_base + k) for k in counts
         ]
         metrics.CAPACITY_PROBES.inc(len(counts))
+        metrics.NODE_BUCKET.set(n_pad_sweep)
+        reuse.rungs_touched.add(n_pad_sweep)
+        pre = reuse.preencoded(max(counts), n_pad_sweep)
         t0 = time.monotonic()
-        with span("capacity-sweep", lanes=len(counts), phase=phase):
-            outs = guarded_call(
-                "capacity-sweep",
-                lambda: Simulator(
-                    trial, weights=weights, use_greed=use_greed,
-                    n_pad=n_pad_sweep, expand_cache=expand_cache,
-                ).run_scenarios(apps, scenarios, materialize=False),
-                call_deadline_s(),
+        holder = {}
+
+        def run():
+            sim = Simulator(
+                trial, weights=weights, use_greed=use_greed,
+                n_pad=n_pad_sweep, expand_cache=expand_cache,
+                preencoded=pre,
             )
+            holder["sim"] = sim
+            return sim.run_scenarios(apps, scenarios, materialize=False)
+
+        with span("capacity-sweep", lanes=len(counts), phase=phase):
+            outs = guarded_call("capacity-sweep", run, call_deadline_s())
         if outs is None:
             return None
+        reuse.capture(holder["sim"], n_base + max(counts), n_pad_sweep)
         metrics.BATCH_SWEEP_DURATION.observe(time.monotonic() - t0)
         verdicts = [_good_outcome(o) for o in outs]
         attempts += len(counts)
@@ -509,7 +606,7 @@ def plan_capacity(
         n_pad_ladder = 0
         for start in range(0, len(ladder), LADDER_LANES):
             chunk = ladder[start:start + LADDER_LANES]
-            n_pad_ladder = round_up(n_base + chunk[-1], 64)
+            n_pad_ladder = node_bucket(n_base + chunk[-1])
             verdicts = sweep(chunk, n_pad_ladder, "ladder")
             if verdicts is None:
                 fell_back = True
@@ -563,7 +660,7 @@ def plan_capacity(
                 if bads:
                     lo = max(bads)
             if not fell_back:
-                best_result = finalize(hi, round_up(n_base + hi, 64))
+                best_result = finalize(hi, node_bucket(n_base + hi))
                 metrics.CAPACITY_NODES_ADDED.set(hi)
                 return CapacityPlan(
                     hi, best_result, attempts, retries, batched_calls
@@ -580,7 +677,7 @@ def plan_capacity(
     lo, hi = 0, (seed_hi or 1)
     best_result: Optional[SimulateResult] = None
     while hi <= max_new_nodes:
-        # (exponential probes rely on encode_nodes' default round_up(n, 64)
+        # (exponential probes rely on encode_nodes' default node_bucket(n)
         # padding; only the bisection below needs an explicit pin, so every
         # mid-probe shares the bracket's bucket)
         g, hi_result = probe(hi)
@@ -592,7 +689,7 @@ def plan_capacity(
     else:
         return None
     best = hi
-    n_pad = round_up(n_base + hi, 64)
+    n_pad = node_bucket(n_base + hi)
     while lo + 1 < hi:
         mid = (lo + hi) // 2
         g, res = probe(mid, n_pad=n_pad)

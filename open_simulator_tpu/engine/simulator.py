@@ -260,6 +260,7 @@ class Simulator:
         expand_cache=None,
         extenders=None,
         resident=None,
+        preencoded=None,
     ) -> None:
         """`mesh` (jax.sharding.Mesh or None): when set, the node axis of the
         cluster state is sharded across the mesh devices and the same grouped
@@ -287,7 +288,15 @@ class Simulator:
         `expand_cache` and `patch_pods` compose only for DaemonSets (patched
         every run, like the reference patches on every Simulate): non-DS
         hooks would run once per cache lifetime, silently diverging from
-        WithPatchPodsFuncMap semantics — that combination raises."""
+        WithPatchPodsFuncMap semantics — that combination raises.
+
+        `preencoded` ((Encoder, NodeTable) or None): capacity-sweep reuse —
+        adopt an already-built encoder and node table (delta-updated by the
+        caller to match `cluster.nodes` exactly; see
+        capacity._TrialReuse) instead of running encode_nodes. The table's
+        node axis must equal `n_pad`. Pods are still registered on the
+        shared encoder — registration is content-keyed and idempotent, so
+        re-registering the same workload is free and never shifts ids."""
         self.cluster = cluster
         self.use_greed = use_greed
         self.mesh = mesh
@@ -361,10 +370,19 @@ class Simulator:
         ignored_res = [
             r for e in self._extenders for r in e.cfg.ignored_resources
         ]
-        self.enc = Encoder(
-            topology_keys=("kubernetes.io/hostname",),
-            ignored_resources=ignored_res,
-        )
+        self._preencoded = preencoded
+        if preencoded is not None:
+            if n_pad is None or preencoded[1].alloc.shape[0] != n_pad:
+                raise ValueError(
+                    "preencoded table node axis "
+                    f"{preencoded[1].alloc.shape[0]} must equal n_pad={n_pad}"
+                )
+            self.enc = preencoded[0]
+        else:
+            self.enc = Encoder(
+                topology_keys=("kubernetes.io/hostname",),
+                ignored_resources=ignored_res,
+            )
         self._bound: List[Tuple[Pod, str]] = []   # (pod, node name)
         self._pending_cluster: List[Pod] = []
         for pod in cluster.pods:
@@ -441,17 +459,29 @@ class Simulator:
         self.enc.register_pods(list(all_pods))
         for pod, _ in self._bound:
             self.enc.register_pods([pod])
-        self._table = encode_nodes(
-            self.enc,
-            self.cluster.nodes,
-            existing_usage=aggregate_usage(self._bound),
-            existing_gpu=aggregate_gpu_usage(self.cluster.nodes, self._bound),
-            n_pad=(
-                self.n_pad
-                if self.n_pad and self.n_pad >= len(self.cluster.nodes)
-                else None
-            ),
-        )
+        if self._preencoded is not None:
+            # Capacity-sweep reuse: the caller delta-updated this table to
+            # match cluster.nodes (asserted cheap: row count and axis width).
+            self._table = self._preencoded[1]
+            if len(self._table.names) != len(self.cluster.nodes):
+                raise ValueError(
+                    f"preencoded table holds {len(self._table.names)} rows "
+                    f"but the cluster has {len(self.cluster.nodes)} nodes"
+                )
+        else:
+            self._table = encode_nodes(
+                self.enc,
+                self.cluster.nodes,
+                existing_usage=aggregate_usage(self._bound),
+                existing_gpu=aggregate_gpu_usage(
+                    self.cluster.nodes, self._bound
+                ),
+                n_pad=(
+                    self.n_pad
+                    if self.n_pad and self.n_pad >= len(self.cluster.nodes)
+                    else None
+                ),
+            )
         self._ns = node_static_from_table(self.enc, self._table)
         sel = initial_selector_counts(self.enc, self._table, self._bound)
         ports = initial_port_counts(self.enc, self._table, self._bound)
@@ -1437,29 +1467,54 @@ class Simulator:
                 np.stack(weight_rows).astype(np.float32)
             )
             carry_s = stack_carry(self._carry, s_pad)
-            # Under a mesh the sweep shards its LANE axis across the same
+            # Under a 1-D mesh the sweep shards its LANE axis across the same
             # devices (scenario lanes are independent — no collectives), with
-            # the node tensors replicated per device. A dedicated local
-            # (ns_sweep, smesh) pair keeps the scenario-mesh placement out of
+            # the node tensors replicated per device. Under an explicit 2-D
+            # (scenarios, nodes) mesh (parallel.mesh.product_mesh_2d) the
+            # node axis is sharded too — node tables are no longer
+            # replicated, and the per-node kernels run on local shards with
+            # GSPMD lowering the reductions to collectives. A dedicated
+            # local (ns_sweep, smesh) pair keeps the sweep placement out of
             # self._ns, whose node-mesh sharding the serial path owns.
             smesh = None
             ns_sweep = self._ns
+            shard_fn = None
             if self.mesh is not None:
-                ndev = int(self.mesh.devices.size)
-                if s_pad % ndev == 0:
-                    from ..parallel.mesh import (
-                        scenario_mesh,
-                        shard_scenarios,
-                    )
+                from ..parallel.mesh import (
+                    NODE_AXIS,
+                    SCENARIO_AXIS,
+                    scenario_mesh,
+                    shard_scenarios,
+                    shard_scenarios_2d,
+                )
 
-                    smesh = scenario_mesh(self.mesh)
-                    ns_sweep, carry_s, valid_s, weights_s = shard_scenarios(
-                        smesh, self._ns, carry_s, valid_s, weights_s
-                    )
+                axes = self.mesh.axis_names
+                if SCENARIO_AXIS in axes and NODE_AXIS in axes:
+                    s_devs = int(self.mesh.shape[SCENARIO_AXIS])
+                    n_devs = int(self.mesh.shape[NODE_AXIS])
+                    n_axis = int(self._table.alloc.shape[0])
+                    if s_pad % s_devs == 0 and n_axis % n_devs == 0:
+                        smesh = self.mesh
+                        shard_fn = shard_scenarios_2d
+                    else:
+                        progress(
+                            "scenario sweep unsharded: %d lanes x %d node "
+                            "rows not divisible by the %dx%d mesh",
+                            s_pad, n_axis, s_devs, n_devs,
+                        )
                 else:
-                    progress(
-                        "scenario sweep unsharded: %d lanes not divisible "
-                        "by %d devices", s_pad, ndev,
+                    ndev = int(self.mesh.devices.size)
+                    if s_pad % ndev == 0:
+                        smesh = scenario_mesh(self.mesh)
+                        shard_fn = shard_scenarios
+                    else:
+                        progress(
+                            "scenario sweep unsharded: %d lanes not "
+                            "divisible by %d devices", s_pad, ndev,
+                        )
+                if smesh is not None:
+                    ns_sweep, carry_s, valid_s, weights_s = shard_fn(
+                        smesh, self._ns, carry_s, valid_s, weights_s
                     )
             lanes = [
                 {"placed": [], "failed": [], "fail_counts": None}
@@ -1501,11 +1556,8 @@ class Simulator:
                         # growth rebuilt leaves off-mesh; re-pin before the
                         # next sharded call (identity check above keeps the
                         # steady state free of redundant device_puts)
-                        ns_sweep, carry_s, valid_s, weights_s = (
-                            shard_scenarios(
-                                smesh, self._ns, carry_s,
-                                valid_s, weights_s,
-                            )
+                        ns_sweep, carry_s, valid_s, weights_s = shard_fn(
+                            smesh, self._ns, carry_s, valid_s, weights_s,
                         )
                     elif smesh is None:
                         ns_sweep = self._ns

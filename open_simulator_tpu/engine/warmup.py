@@ -14,8 +14,15 @@ over the full capacity sweep, see jaxpr_audit.warm_start_check).
 
 The registry is not a second list to keep in sync: `warmup_registry()`
 replays jaxpr_audit's capture pass, so the warmup set and the audit set
-are the same 18 entries by construction, and a jit entry added without
-audit coverage fails both gates at once.
+are identical by construction (one entry per AUDIT_TARGETS attr), and a
+jit entry added without audit coverage fails both gates at once.
+
+Node-axis shapes come from the bucket ladder (ops.encode.node_bucket):
+the sweep rehearsal touches the same ladder rungs a production capacity
+search rounds to, so the report's ``ladder_rungs`` names exactly the
+node-axis shape family the cache banked — an off-ladder rung in a later
+run is a shape the warmup could not have pre-compiled, and the recompile
+guard's ``ladder_ok`` flags it.
 
 Donation interacts cleanly: ``Function.trace`` only needs avals, so
 entries that donate buffers (ops.delta scatters, the scenario commit
@@ -70,6 +77,8 @@ class WarmupReport:
     persistent_hits: int
     cache_dir: str = ""
     swept: bool = True
+    #: node-bucket ladder rungs the sweep rehearsal compiled programs for
+    ladder_rungs: List[int] = dataclasses.field(default_factory=list)
 
     @property
     def cold_compiles(self) -> int:
@@ -90,6 +99,7 @@ class WarmupReport:
             "cold_compiles": self.cold_compiles,
             "cache_dir": self.cache_dir,
             "swept": self.swept,
+            "ladder_rungs": list(self.ladder_rungs),
         }
 
     def render_text(self) -> str:
@@ -104,6 +114,10 @@ class WarmupReport:
             lines.append(f"  cache: {self.cache_dir}")
         if not self.swept:
             lines.append("  sweep rehearsal: skipped (--no-sweep)")
+        elif self.ladder_rungs:
+            lines.append(
+                f"  node-bucket rungs banked: {self.ladder_rungs}"
+            )
         for e in sorted(self.entries, key=lambda e: -e.seconds):
             don = (
                 f"  donates {e.donated}" if e.donated else ""
@@ -142,7 +156,7 @@ def run_warmup(include_sweep: bool = True) -> WarmupReport:
        compiles over the same sweep.
     """
     from ..analysis.jaxpr_audit import REQUIRED_COVERAGE, _run_sweeps
-    from ..ops.fast import reset_scenario_programs
+    from ..ops.fast import reset_scenario_programs, scenario_programs
     from ..utils.platform import (
         CompileCounter,
         enable_compilation_cache,
@@ -179,4 +193,5 @@ def run_warmup(include_sweep: bool = True) -> WarmupReport:
         persistent_hits=counter.persistent_hits,
         cache_dir=cache_dir or "",
         swept=include_sweep,
+        ladder_rungs=sorted({n for (n, _p) in scenario_programs()}),
     )

@@ -19,15 +19,19 @@ Bucketed padding (`round_up`) keeps jit cache hits across add-node iterations.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
+from ..utils import metrics as _metrics
+
 from ..core.matcher import match_label_selector
 from ..core.objects import (
     ANNO_GPU_COUNT_POD,
     ANNO_GPU_MEM_POD,
+    ANNO_NODE_LOCAL_STORAGE,
     ANNO_POD_LOCAL_STORAGE,
     RESOURCE_GPU_COUNT,
     LabelSelector,
@@ -70,15 +74,43 @@ def resource_scale(name: str) -> float:
     return 1.0
 
 
-def round_up(n: int, minimum: int = 8) -> int:
+def round_up(n: int, floor: int = 8, step: int = 4096) -> int:
     """Bucket a dynamic size so jit caches hit across add-node iterations and
-    varying app sizes: next power of two below 4096, then multiples of 4096
-    (bounds padding waste to <1/16 for big batches where scan steps are paid
-    per padded row)."""
-    size = max(n, minimum, 1)
-    if size <= 4096:
+    varying app sizes: next power of two up to `step`, then multiples of
+    `step` (bounds padding waste to <1/16 for big batches where scan steps
+    are paid per padded row).
+
+    `floor` is the smallest bucket ever returned; `step` is the linear
+    granularity past the power-of-two region. They are distinct knobs: the
+    old `minimum` name suggested granularity but only ever set the floor."""
+    size = max(n, floor, 1)
+    if size <= step:
         return 1 << (size - 1).bit_length()
-    return (size + 4095) // 4096 * 4096
+    return (size + step - 1) // step * step
+
+
+# The node-axis shape ladder (ROADMAP 5(b), docs/performance.md): every node
+# table pads to a rung, so the jit family compiles a finite program set no
+# matter how node counts grow — powers of two from the floor up to the step,
+# then multiples of the step: 64, 128, ..., 4096, 8192, 12288, ...
+NODE_BUCKET_FLOOR = 64
+NODE_BUCKET_STEP = 4096
+
+
+def node_bucket(n: int) -> int:
+    """The ladder rung (padded node-axis length) covering `n` real nodes.
+    Tiny clusters pay a few inert padded rows; in exchange the engine keeps
+    one compiled program per rung instead of one per node count."""
+    return round_up(n, floor=NODE_BUCKET_FLOOR, step=NODE_BUCKET_STEP)
+
+
+def ladder_rungs(n_max: int) -> List[int]:
+    """Every ladder rung up to and including the one covering `n_max` — the
+    complete program family a capacity sweep over [1, n_max] can touch."""
+    rungs = [NODE_BUCKET_FLOOR]
+    while rungs[-1] < n_max:
+        rungs.append(node_bucket(rungs[-1] + 1))
+    return rungs
 
 
 class Vocab:
@@ -396,6 +428,11 @@ class PodBatch:
 
 
 def _num_or_nan(s: str) -> float:
+    # Fast reject before the try: raising costs ~1.5us per call and nearly
+    # every label value / node name is non-numeric (k8s label values cannot
+    # start with whitespace, so the leading-char test loses nothing).
+    if not s or not (s[0].isdigit() or s[0] in "+-"):
+        return float("nan")
     try:
         return float(int(s))
     except ValueError:
@@ -523,6 +560,66 @@ def encode_node_into(
             table.dev_free[i, j] = 0.0 if dev.is_allocated else 1.0
 
 
+# Per-row NodeTable array fields the template-stamping pass broadcasts from
+# a template row to its clone rows (every array in the dataclass; `names` is
+# the only non-array field and is built separately).
+_STAMP_FIELDS = (
+    "alloc", "free", "label_pair", "label_key", "label_num",
+    "taint_key", "taint_val", "taint_effect", "name_id", "unsched",
+    "avoid_pods", "topo", "valid", "gpu_total", "gpu_free",
+    "vg_cap", "vg_free", "vg_name", "dev_cap", "dev_ssd", "dev_free",
+    "has_storage",
+)
+
+# Placeholder for "this label holds the node's own name" in template
+# signatures — a control character no real label value can contain.
+_OWN_NAME_SENTINEL = "\x00own-name\x00"
+
+
+def _node_stamp_sig(
+    enc: Encoder,
+    nd: Node,
+    usage: Dict[str, Dict[str, int]],
+    gpu_usage: Dict[str, np.ndarray],
+    st: Optional[NodeLocalStorage],
+    host_key: str,
+) -> Tuple:
+    """Template signature: nodes with equal signatures encode to identical
+    table rows except the name-derived cells (name_id, topo[:, 0], and — when
+    the hostname label carries the node's own name — that label slot's pair
+    id and numeric view), which the stamping pass fixes up per clone row.
+    Covers exactly the inputs encode_node_into reads. The hostname label
+    value is replaced by a sentinel only when it equals the node's own name;
+    a literal hostname value stays in the signature, so nodes are never
+    merged across a real content difference."""
+    g_cnt = nd.gpu_count()
+    g_used = gpu_usage.get(nd.name)
+    labels = []
+    for k in sorted(nd.meta.labels):
+        v = nd.meta.labels[k]
+        if k == host_key and v == nd.name:
+            v = _OWN_NAME_SENTINEL
+        labels.append((k, v))
+    return (
+        tuple(sorted(nd.allocatable.items())),
+        tuple(labels),
+        tuple((t.key, t.value, t.effect) for t in nd.taints),
+        nd.unschedulable,
+        "scheduler.alpha.kubernetes.io/preferAvoidPods" in nd.meta.annotations,
+        g_cnt,
+        nd.gpu_mem_per_device() if g_cnt > 0 else 0,
+        tuple(sorted(usage.get(nd.name, {}).items())),
+        None if g_used is None else tuple(np.asarray(g_used).tolist()),
+        None if st is None else (
+            tuple((vg.name, vg.capacity, vg.requested) for vg in st.vgs),
+            tuple(
+                (d.capacity, d.media_type, d.is_allocated)
+                for d in st.devices
+            ),
+        ),
+    )
+
+
 def encode_nodes(
     enc: Encoder,
     nodes: Sequence[Node],
@@ -530,22 +627,97 @@ def encode_nodes(
     existing_gpu: Optional[Dict[str, np.ndarray]] = None,
     n_pad: Optional[int] = None,
     min_axes: Optional[Tuple[int, int, int, int, int]] = None,
+    stamp: Optional[bool] = None,
 ) -> NodeTable:
     """Build the node table. existing_usage maps node name -> canonical request
     totals of already-bound pods (subtracted into `free`); existing_gpu maps
     node name -> used MiB per device (from aggregate_gpu_usage). min_axes is an
     optional (L, T, G, V, DV) floor — the resident path pins it to its resident
-    bucket sizes so a verification re-encode lands in identical shapes."""
+    bucket sizes so a verification re-encode lands in identical shapes.
+
+    `stamp` controls the template-stamping fast path (None reads
+    OSIM_STAMP_ENCODE, default on): each distinct node spec is encoded once
+    with encode_node_into, then its clones are stamped by a vectorized row
+    broadcast plus per-row name fixups. Capacity planning adds copies of one
+    node type, so at 100k nodes this turns an O(minutes) Python loop into a
+    handful of row encodes plus numpy broadcasts. Byte-identical to the loop
+    encode by construction: the signature covers every input the row encode
+    reads, and clones intern their name-derived vocab entries at their loop
+    position, so vocab ids match the loop encode exactly."""
     n = len(nodes)
-    # Node-axis floor of 64: tiny clusters pay a few inert padded rows, and
-    # in exchange the whole jit family (scan/traj/light/sort) keeps ONE shape
-    # across interactive runs and most capacity-search probes — tracing the
-    # big scheduling graphs dominates small-cluster wall time otherwise.
-    N = n_pad if n_pad is not None else round_up(n, 64)
+    # Node-axis ladder floor of 64 (node_bucket): tiny clusters pay a few
+    # inert padded rows, and in exchange the whole jit family
+    # (scan/traj/light/sort) keeps ONE shape across interactive runs and
+    # most capacity-search probes — tracing the big scheduling graphs
+    # dominates small-cluster wall time otherwise.
+    N = n_pad if n_pad is not None else node_bucket(n)
     R = len(enc.resources)
     K = max(len(enc.topology_keys), 1)
-    storages = [nd.local_storage() for nd in nodes]
-    L, T, G, V, DV = node_axes(enc, nodes, storages)
+    usage = existing_usage or {}
+    gpu_usage = existing_gpu or {}
+    if stamp is None:
+        stamp = os.environ.get("OSIM_STAMP_ENCODE", "1") != "0"
+    stamp = bool(stamp) and n >= 2
+
+    storages: List[Optional[NodeLocalStorage]] = []
+    storages_by_row: Dict[int, Optional[NodeLocalStorage]] = {}
+    sigs: List[Tuple] = []
+    if stamp:
+        # Signature pre-pass. Capacity clones carry a `_stamp_token` (minted
+        # by engine.capacity.new_fake_nodes): identity keying like
+        # _pod_row_sig's, which makes their signature a handful of dict
+        # lookups instead of a full content tuple — the difference between
+        # O(rows) Python and O(templates) Python at 100k nodes. Everything a
+        # materializing run may mutate (unschedulable, the storage
+        # annotation, usage maps) stays in the token signature, so a drifted
+        # clone falls out of the group instead of merging wrongly. Axis caps
+        # (node_axes) are computed over one representative per distinct
+        # signature — group members are content-equal, so the max is the max.
+        host_key = enc.topology_keys[0]
+        ax_nodes: List[Node] = []
+        ax_st: List[Optional[NodeLocalStorage]] = []
+        seen_tok: Dict[object, Tuple] = {}
+        names_list: List[str] = []
+        no_usage = not usage and not gpu_usage
+        for i, nd in enumerate(nodes):
+            meta = nd.meta
+            name = meta.name
+            names_list.append(name)
+            tok = nd.__dict__.get("_stamp_token")
+            if tok is not None:
+                if no_usage:
+                    sig = (
+                        tok,
+                        nd.unschedulable,
+                        meta.annotations.get(ANNO_NODE_LOCAL_STORAGE),
+                    )
+                else:
+                    sig = (
+                        tok,
+                        nd.unschedulable,
+                        meta.annotations.get(ANNO_NODE_LOCAL_STORAGE),
+                        tuple(sorted(usage[name].items()))
+                        if name in usage else None,
+                        tuple(np.asarray(gpu_usage[name]).tolist())
+                        if name in gpu_usage else None,
+                    )
+                prev = seen_tok.get(tok)
+                if prev is None:
+                    seen_tok[tok] = sig
+                if prev is None or prev != sig:
+                    ax_nodes.append(nd)
+                    ax_st.append(nd.local_storage())
+            else:
+                st = nd.local_storage()
+                storages_by_row[i] = st
+                sig = _node_stamp_sig(enc, nd, usage, gpu_usage, st, host_key)
+                ax_nodes.append(nd)
+                ax_st.append(st)
+            sigs.append(sig)
+        L, T, G, V, DV = node_axes(enc, ax_nodes, ax_st)
+    else:
+        storages = [nd.local_storage() for nd in nodes]
+        L, T, G, V, DV = node_axes(enc, nodes, storages)
     if min_axes is not None:
         L = max(L, min_axes[0])
         T = max(T, min_axes[1])
@@ -576,8 +748,6 @@ def encode_nodes(
     dev_free = np.zeros((N, DV), np.float32)
     has_storage = np.zeros(N, bool)
 
-    usage = existing_usage or {}
-    gpu_usage = existing_gpu or {}
     table = NodeTable(
         alloc=alloc, free=free, label_pair=label_pair, label_key=label_key,
         label_num=label_num, taint_key=taint_key, taint_val=taint_val,
@@ -587,10 +757,85 @@ def encode_nodes(
         vg_cap=vg_cap, vg_free=vg_free, vg_name=vg_name,
         dev_cap=dev_cap, dev_ssd=dev_ssd, dev_free=dev_free,
         has_storage=has_storage,
-        names=[nd.name for nd in nodes],
+        names=names_list if stamp else [nd.meta.name for nd in nodes],
     )
-    for i, nd in enumerate(nodes):
-        encode_node_into(enc, table, i, nd, usage, gpu_usage, st=storages[i])
+    if not stamp:
+        for i, nd in enumerate(nodes):
+            encode_node_into(
+                enc, table, i, nd, usage, gpu_usage, st=storages[i]
+            )
+        return table
+
+    # Template-stamping pass. Sequential over nodes so every vocab intern
+    # happens at the same global position the per-node loop would do it.
+    first_row: Dict[Tuple, int] = {}
+    # template row -> [(clone row, name_id, hostname pair_id, num(name))]
+    clones: Dict[int, List[Tuple[int, int, int, float]]] = {}
+    host_bound: Dict[int, bool] = {}
+    # Interning inlined against the raw vocab dicts: three method calls per
+    # clone add up to most of the pass at 100k rows (Vocab.id semantics,
+    # verbatim).
+    names_d = enc.names._ids
+    vals_d = enc.vals._ids
+    pairs_d = enc.pairs._ids
+    _nan = float("nan")
+    for i, sig in enumerate(sigs):
+        tmpl = first_row.get(sig)
+        if tmpl is None:
+            nd = nodes[i]
+            first_row[sig] = i
+            host_bound[i] = nd.meta.labels.get(host_key) == names_list[i]
+            encode_node_into(
+                enc, table, i, nd, usage, gpu_usage,
+                st=storages_by_row.get(i, _STORAGE_UNSET),
+            )
+            continue
+        # The clone's only new vocab entries vs its template are its name and
+        # (when hostname-bound) its hostname label pair; intern them NOW, at
+        # this node's loop position, so ids match the loop encode exactly.
+        # (pair_id(host_key, name) minus its keys.id call, which is a pure
+        # hit — the template row already interned host_key.)
+        name = names_list[i]
+        nid = names_d.get(name)
+        if nid is None:
+            nid = len(names_d) + 1
+            names_d[name] = nid
+        if host_bound[tmpl]:
+            if name not in vals_d:
+                vals_d[name] = len(vals_d) + 1
+            pair = host_key + "=" + name
+            pid = pairs_d.get(pair)
+            if pid is None:
+                pid = len(pairs_d) + 1
+                pairs_d[pair] = pid
+            num = _num_or_nan(name)
+        else:
+            pid, num = 0, _nan
+        clones.setdefault(tmpl, []).append((i, nid, pid, num))
+    stamped = 0
+    for tmpl, rows in clones.items():
+        idx = np.fromiter((r[0] for r in rows), np.int32, len(rows))
+        for f in _STAMP_FIELDS:
+            arr = getattr(table, f)
+            arr[idx] = arr[tmpl]
+        table.name_id[idx] = np.fromiter(
+            (r[1] for r in rows), np.int32, len(rows)
+        )
+        table.topo[idx, 0] = idx  # hostname: every node is its own domain
+        if host_bound[tmpl]:
+            # the hostname label sits at the same sorted-label slot on every
+            # clone (labels sort by key; only its value differs)
+            key_id = enc.keys.get(host_key)
+            j = int(np.nonzero(table.label_key[tmpl] == key_id)[0][0])
+            table.label_pair[idx, j] = np.fromiter(
+                (r[2] for r in rows), np.int32, len(rows)
+            )
+            table.label_num[idx, j] = np.fromiter(
+                (r[3] for r in rows), np.float32, len(rows)
+            )
+        stamped += len(rows)
+    if stamped:
+        _metrics.ENCODE_STAMPED_ROWS.inc(stamped)
     return table
 
 

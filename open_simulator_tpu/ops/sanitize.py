@@ -1,8 +1,8 @@
 """Runtime sanitizer mode for the jit entry points (OSIM_SANITIZE=1).
 
-`@sanitizable(name, ...)` stacks ABOVE the `jax.jit` decorator on each of
-the 12 production entry points (ops/fast.py, ops/grouped.py,
-ops/kernels.py). With the env knob off the wrapper is a single dict
+`@sanitizable(name, ...)` stacks ABOVE the `jax.jit` decorator on every
+production entry point (ops/fast.py, ops/grouped.py, ops/kernels.py,
+ops/delta.py). With the env knob off the wrapper is a single dict
 lookup + call-through to the jitted function, so the fast path stays the
 fast path. With `OSIM_SANITIZE=1` the same entry runs under
 `jax.experimental.checkify` with NaN, out-of-bounds-index and

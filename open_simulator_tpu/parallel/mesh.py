@@ -18,6 +18,15 @@ is embarrassingly parallel — `scenario_mesh` / `shard_scenarios` split the
 stacked carry, per-lane valid masks and weight rows across devices along
 axis 0 with the node tensors replicated, and each device runs its lanes
 with zero cross-device traffic until the host gathers results.
+
+Both directions compose: `product_mesh_2d` builds an explicit 2-D
+(scenarios, nodes) mesh and `shard_scenarios_2d` lays the sweep out over
+it — lanes split over the scenario axis AND every node-axis tensor (the
+shared NodeStatic and the per-lane carry planes) splits over the node
+axis, so a 100k-node table occupies 1/n_devices of each device's HBM
+instead of being replicated per device. The per-node filter/score kernels
+run on local (lane, node) shards; argmax/min-max/domain reductions lower
+to collectives over the node axis only, inserted by GSPMD.
 """
 
 from __future__ import annotations
@@ -144,6 +153,91 @@ def shard_scenarios(
     valid_sh = jax.device_put(valid_s, lane)
     weights_sh = jax.device_put(weights_s, lane)
     return ns_sh, carry_sh, valid_sh, weights_sh
+
+
+def product_mesh_2d(
+    scenario_devices: int, node_devices: int
+) -> Optional[Mesh]:
+    """An explicit 2-D (SCENARIO_AXIS, NODE_AXIS) mesh over the first
+    scenario_devices x node_devices of jax.devices(). The multi-scenario
+    sweep shards lanes over the first axis and the node tables over the
+    second (shard_scenarios_2d); the serial engine's node_sharding /
+    carry_sharding specs name only NODE_AXIS, so they compose with this
+    mesh unchanged (unnamed axes replicate). Returns None for the 1x1
+    degenerate mesh — single-device runs skip sharding entirely."""
+    import numpy as np
+
+    if scenario_devices < 1 or node_devices < 1:
+        raise ValueError(
+            f"mesh axes must be >= 1, got {scenario_devices}x{node_devices}"
+        )
+    want = scenario_devices * node_devices
+    if want == 1:
+        return None
+    devices = jax.devices()
+    if want > len(devices):
+        raise ValueError(
+            f"{scenario_devices}x{node_devices} mesh needs {want} devices "
+            f"but only {len(devices)} JAX devices are visible"
+        )
+    grid = np.array(devices[:want]).reshape(scenario_devices, node_devices)
+    return Mesh(grid, (SCENARIO_AXIS, NODE_AXIS))
+
+
+def shard_scenarios_2d(
+    mesh: Mesh,
+    ns: NodeStatic,
+    carry_s: Carry,
+    valid_s: jnp.ndarray,
+    weights_s: jnp.ndarray,
+):
+    """device_put the stacked sweep state onto a 2-D (scenarios, nodes)
+    mesh: [S, ...] tensors split on the lane axis AND their node axis, the
+    shared NodeStatic splits on its node axis only (node_sharding's specs
+    name NODE_AXIS; the unnamed SCENARIO_AXIS replicates it across lane
+    rows). Callers must ensure S divides the scenario-axis size and the
+    padded node axis divides the node-axis size — node_bucket keeps N a
+    multiple of 64, so 2/4/8-way node splits always divide."""
+    s = lambda *spec: NamedSharding(mesh, P(*spec))
+    carry_sh = jax.device_put(
+        carry_s,
+        Carry(
+            free=s(SCENARIO_AXIS, NODE_AXIS, None),
+            sel_counts=s(SCENARIO_AXIS, None, NODE_AXIS),
+            gpu_free=s(SCENARIO_AXIS, NODE_AXIS, None),
+            vg_free=s(SCENARIO_AXIS, NODE_AXIS, None),
+            dev_free=s(SCENARIO_AXIS, NODE_AXIS, None),
+            port_any=s(SCENARIO_AXIS, None, NODE_AXIS),
+            port_wild=s(SCENARIO_AXIS, None, NODE_AXIS),
+            port_ipc=s(SCENARIO_AXIS, None, NODE_AXIS),
+            anti_counts=s(SCENARIO_AXIS, None, NODE_AXIS),
+        ),
+    )
+    ns_sh = jax.device_put(ns, node_sharding(mesh))
+    valid_sh = jax.device_put(valid_s, s(SCENARIO_AXIS, NODE_AXIS))
+    weights_sh = jax.device_put(weights_s, s(SCENARIO_AXIS))
+    return ns_sh, carry_sh, valid_sh, weights_sh
+
+
+def hbm_bytes_per_device(*trees) -> dict:
+    """Actual bytes resident per device for the given pytrees of jax.Arrays
+    — summed over each leaf's addressable shards, so a sharded layout
+    reports its true per-device footprint while a replicated layout reports
+    the full tensor on every device. Snapshots into the
+    osim_hbm_bytes_per_device gauge and returns {device: bytes}."""
+    from ..utils import metrics
+
+    out: dict = {}
+    for tree in trees:
+        for leaf in jax.tree.leaves(tree):
+            if not hasattr(leaf, "addressable_shards"):
+                continue
+            for shard in leaf.addressable_shards:
+                key = str(shard.device)
+                out[key] = out.get(key, 0) + int(shard.data.nbytes)
+    for dev, nbytes in sorted(out.items()):
+        metrics.HBM_BYTES_PER_DEVICE.set(nbytes, device=dev)
+    return out
 
 
 def sharded_schedule_batch(mesh: Mesh):

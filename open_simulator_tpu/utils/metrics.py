@@ -626,6 +626,26 @@ JOBS = REGISTRY.counter(
     "(completed | failed | rejected).",
     labelnames=("outcome",),
 )
+NODE_BUCKET = REGISTRY.gauge(
+    "osim_node_bucket",
+    "Node-axis ladder rung (padded node count, ops.encode.node_bucket) of "
+    "the most recent encode or capacity-sweep device call — the shape the "
+    "jit family compiled for.",
+)
+ENCODE_STAMPED_ROWS = REGISTRY.counter(
+    "osim_encode_stamped_rows_total",
+    "Node rows materialized by the template-stamping encode fast path (row "
+    "broadcast of a previously-encoded identical node spec plus per-row "
+    "name fixups) instead of the per-node Python encode loop.",
+)
+HBM_BYTES_PER_DEVICE = REGISTRY.gauge(
+    "osim_hbm_bytes_per_device",
+    "Bytes of cluster-state shards resident on each device after the most "
+    "recent sharded placement (parallel.mesh.hbm_bytes_per_device) — under "
+    "the 2-D (scenarios, nodes) mesh this stays ~1/node_devices of the "
+    "replicated node-table footprint.",
+    labelnames=("device",),
+)
 
 # Span names that map onto a dedicated kube-parity histogram; everything
 # else lands only in osim_span_duration_seconds{span=...}.

@@ -1,0 +1,427 @@
+"""Node-bucket ladder, template-stamped encode, and the 2-D
+(scenarios, nodes) mesh (docs/performance.md, "Node-bucket ladder").
+
+Three contracts:
+  - shapes: `round_up(floor, step)` and `node_bucket` pin the exact ladder
+    the jit program family compiles against — any drift is a silent
+    recompile storm, so the rungs are regression-pinned here;
+  - bytes: the template-stamping fast path in `encode_nodes` must be
+    byte-identical to the per-node loop encode over arbitrary node
+    populations (GPU, local-storage, taints, usage maps included);
+  - digests: padding to a bigger rung, and sharding the sweep over a 2-D
+    (scenarios, nodes) mesh, must not change a single placement or reason.
+"""
+
+import random
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from open_simulator_tpu.core.objects import Node
+from open_simulator_tpu.core.workloads import reset_name_rng
+from open_simulator_tpu.engine.simulator import Scenario, simulate, simulate_batch
+from open_simulator_tpu.ops.encode import (
+    NODE_BUCKET_FLOOR,
+    NODE_BUCKET_STEP,
+    Encoder,
+    _STAMP_FIELDS,
+    encode_nodes,
+    ladder_rungs,
+    node_bucket,
+    round_up,
+)
+from tests.factories import make_node
+from tests.test_batch_engine import digest, overflow_fixture
+
+# ---------------------------------------------------------------------------
+# shape regression: the ladder itself
+# ---------------------------------------------------------------------------
+
+
+def test_round_up_floor_and_step_are_distinct_knobs():
+    assert round_up(1) == 8          # default floor
+    assert round_up(9) == 16         # pow2 region
+    assert round_up(4096) == 4096
+    assert round_up(4097) == 8192    # first linear rung
+    assert round_up(1, floor=64) == 64
+    # step bounds the pow2 region: past it, multiples of step
+    assert round_up(100, floor=64, step=32) == 128
+    assert round_up(33, floor=8, step=32) == 64
+    assert round_up(65, floor=8, step=32) == 96
+
+
+def test_node_bucket_pins_the_ladder():
+    assert node_bucket(0) == 64
+    assert node_bucket(1) == 64
+    assert node_bucket(64) == 64
+    assert node_bucket(65) == 128
+    assert node_bucket(4096) == 4096
+    assert node_bucket(4097) == 8192
+    assert node_bucket(8193) == 12288
+    assert node_bucket(100_000) == 102_400
+    # rename-compat: node_bucket is exactly the old round_up(n, 64)
+    for n in (0, 1, 63, 64, 65, 1000, 4095, 4096, 4097, 9000, 123_456):
+        assert node_bucket(n) == round_up(n, floor=64)
+
+
+def test_ladder_rungs_enumerates_the_program_family():
+    assert ladder_rungs(64) == [64]
+    assert ladder_rungs(4097) == [64, 128, 256, 512, 1024, 2048, 4096, 8192]
+    rungs = ladder_rungs(20_000)
+    assert rungs[-1] == node_bucket(20_000) == 20_480
+    # every rung is a fixed point of node_bucket (the ladder_ok contract)
+    for r in rungs:
+        assert node_bucket(r) == r
+    assert NODE_BUCKET_FLOOR == 64 and NODE_BUCKET_STEP == 4096
+
+
+# ---------------------------------------------------------------------------
+# bucket-boundary digest equivalence
+# ---------------------------------------------------------------------------
+
+
+def test_padding_to_a_bigger_rung_changes_nothing():
+    """The same cluster simulated at its natural rung (64) and one rung up
+    (128) must produce byte-identical placements, reasons, preemptions —
+    padded rows are inert, so the rung is purely a compilation shape."""
+    cluster, apps = overflow_fixture()
+    reset_name_rng()
+    ref = simulate(cluster, apps)
+    for n_pad in (128, 256):
+        reset_name_rng()
+        cluster2, apps2 = overflow_fixture()
+        assert digest(simulate(cluster2, apps2, n_pad=n_pad)) == digest(ref)
+
+
+# ---------------------------------------------------------------------------
+# template-stamped encode == loop encode, byte for byte
+# ---------------------------------------------------------------------------
+
+
+def gpu_node(name, count=2, per_dev_mib=16_384):
+    return Node.from_dict(
+        {
+            "metadata": {
+                "name": name,
+                "labels": {"kubernetes.io/hostname": name},
+            },
+            "status": {
+                "allocatable": {
+                    "cpu": "32",
+                    "memory": "128Gi",
+                    "pods": "110",
+                    "alibabacloud.com/gpu-count": str(count),
+                    "alibabacloud.com/gpu-mem": f"{count * per_dev_mib}Mi",
+                }
+            },
+        }
+    )
+
+
+def storage_node(name, vgs=(), devices=()):
+    import json as _json
+
+    from open_simulator_tpu.core.objects import ANNO_NODE_LOCAL_STORAGE
+
+    node = Node.from_dict(
+        {
+            "metadata": {"name": name},
+            "status": {
+                "allocatable": {"cpu": "8", "memory": "16Gi", "pods": "110"}
+            },
+        }
+    )
+    GiB = 1 << 30
+    node.meta.annotations[ANNO_NODE_LOCAL_STORAGE] = _json.dumps(
+        {
+            "vgs": [
+                {"name": n, "capacity": str(c * GiB), "requested": str(r * GiB)}
+                for n, c, r in vgs
+            ],
+            "devices": [
+                {
+                    "name": n,
+                    "device": f"/dev/{n}",
+                    "capacity": str(c * GiB),
+                    "mediaType": m,
+                    "isAllocated": a,
+                }
+                for n, c, m, a in devices
+            ],
+        }
+    )
+    return node
+
+
+def unsched_node(name):
+    return Node.from_dict(
+        {
+            "metadata": {"name": name},
+            "spec": {"unschedulable": True},
+            "status": {
+                "allocatable": {"cpu": "4", "memory": "8Gi", "pods": "110"}
+            },
+        }
+    )
+
+
+def mixed_population(seed, n_specs=6, max_clones=7):
+    """A randomized node population with clone runs of every axis the row
+    encode touches: plain, labeled, tainted, unschedulable, GPU, and
+    local-storage (VG + device) nodes, interleaved."""
+    rng = random.Random(seed)
+    makers = [
+        lambda nm: make_node(nm, cpu="4", memory="8Gi"),
+        lambda nm: make_node(
+            nm, cpu="8", memory="16Gi",
+            with_labels={"zone": f"az-{rng.randint(0, 1)}", "disk": "ssd"},
+        ),
+        lambda nm: make_node(
+            nm, cpu="16", memory="32Gi",
+            with_taints=[
+                {"key": "dedicated", "value": "batch", "effect": "NoSchedule"}
+            ],
+        ),
+        lambda nm: unsched_node(nm),
+        lambda nm: gpu_node(nm, count=rng.choice((1, 4)), per_dev_mib=8192),
+        lambda nm: storage_node(
+            nm,
+            vgs=(("vg-open", 200, 20),),
+            devices=(("sdb", 100, "hdd", False), ("sdc", 50, "ssd", False)),
+        ),
+    ]
+    nodes = []
+    for s in range(n_specs):
+        mk = makers[s % len(makers)]
+        for c in range(rng.randint(2, max_clones)):
+            nodes.append(mk(f"spec{s}-n{c}"))
+    rng.shuffle(nodes)
+    return nodes
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_stamped_encode_is_byte_identical_to_loop_encode(seed):
+    nodes = mixed_population(seed)
+    # usage maps key off node NAME — give a few nodes bound-pod usage and
+    # GPU usage so differing usage splits otherwise-identical specs
+    usage = {nodes[0].name: {"cpu": 2000, "memory": 1 << 30}}
+    gpu = {
+        nd.name: np.array([1024.0], np.float32)
+        for nd in nodes
+        if nd.gpu_count() == 1
+    }
+
+    enc_loop, enc_stamp = Encoder(), Encoder()
+    t_loop = encode_nodes(
+        enc_loop, nodes, existing_usage=usage, existing_gpu=gpu, stamp=False
+    )
+    t_stamp = encode_nodes(
+        enc_stamp, nodes, existing_usage=usage, existing_gpu=gpu, stamp=True
+    )
+
+    for f in _STAMP_FIELDS:
+        a = np.asarray(getattr(t_loop, f))
+        b = np.asarray(getattr(t_stamp, f))
+        # tobytes: NaN-aware (label_num pads with NaN)
+        assert a.tobytes() == b.tobytes(), f"field {f} diverged"
+    assert t_loop.names == t_stamp.names
+    # clone names intern at their loop position: the vocabularies agree
+    assert len(enc_loop.names) == len(enc_stamp.names)
+    assert len(enc_loop.pairs) == len(enc_stamp.pairs)
+
+
+def test_fake_node_clones_stamp_byte_identical():
+    """The identity-token fast path (new_fake_nodes clones skip the content
+    signature) must stay byte-identical to the loop encode — including a
+    clone that drifts out of its group via a bound-usage entry."""
+    from open_simulator_tpu.engine.capacity import new_fake_nodes
+
+    base = [make_node(f"base-{i}", cpu="8", memory="16Gi") for i in range(3)]
+    t1 = make_node("t1", cpu="32", memory="64Gi", with_labels={"zone": "a"})
+    t2 = gpu_node("t2", count=2)
+    nodes = base + new_fake_nodes(t1, 50) + new_fake_nodes(t2, 80, start=50)
+    usage = {"simon-00003": {"cpu": 1000, "memory": 1 << 30}}
+
+    enc_loop, enc_stamp = Encoder(), Encoder()
+    t_loop = encode_nodes(enc_loop, nodes, existing_usage=usage, stamp=False)
+    t_stamp = encode_nodes(enc_stamp, nodes, existing_usage=usage, stamp=True)
+    for f in _STAMP_FIELDS:
+        assert (
+            np.asarray(getattr(t_loop, f)).tobytes()
+            == np.asarray(getattr(t_stamp, f)).tobytes()
+        ), f"field {f} diverged"
+    assert t_loop.names == t_stamp.names
+    assert len(enc_loop.names) == len(enc_stamp.names)
+    assert len(enc_loop.pairs) == len(enc_stamp.pairs)
+
+
+def test_stamped_rows_metric_counts_clones():
+    from open_simulator_tpu.utils import metrics
+
+    nodes = [make_node(f"m-{i}", cpu="4", memory="8Gi") for i in range(10)]
+    before = metrics.ENCODE_STAMPED_ROWS.value()
+    encode_nodes(Encoder(), nodes, stamp=True)
+    assert metrics.ENCODE_STAMPED_ROWS.value() == before + 9  # 1 template
+
+
+@pytest.mark.slow
+def test_stamped_encode_speedup_at_20k_nodes():
+    """Acceptance: >= 10x over the loop encode at 20k clones of one spec —
+    the capacity-plan shape (new_fake_nodes clones of a realistic
+    heterogeneous template: zone/instance-type labels, a taint, GPUs, and
+    open-local storage, so the per-row loop encode pays every axis it would
+    pay in production)."""
+    import json as _json
+
+    from open_simulator_tpu.core.objects import ANNO_NODE_LOCAL_STORAGE
+    from open_simulator_tpu.engine.capacity import new_fake_nodes
+
+    GiB = 1 << 30
+    template = make_node(
+        "tmpl", cpu="32", memory="64Gi",
+        with_labels={
+            "topology.kubernetes.io/zone": "az-1",
+            "node.kubernetes.io/instance-type": "ecs.gn7.8xlarge",
+            "disk": "ssd",
+            "pool": "batch",
+        },
+        with_taints=[
+            {"key": "dedicated", "value": "batch", "effect": "NoSchedule"}
+        ],
+        with_capacity={
+            "alibabacloud.com/gpu-count": "4",
+            "alibabacloud.com/gpu-mem": f"{4 * 16384}Mi",
+        },
+    )
+    template.meta.annotations[ANNO_NODE_LOCAL_STORAGE] = _json.dumps(
+        {
+            "vgs": [{"name": "vg-open", "capacity": str(400 * GiB),
+                     "requested": str(40 * GiB)}],
+            "devices": [{"name": "sdb", "device": "/dev/sdb",
+                         "capacity": str(200 * GiB), "mediaType": "ssd",
+                         "isAllocated": False}],
+        }
+    )
+    nodes = new_fake_nodes(template, 20_000)
+    t0 = time.perf_counter()
+    t_loop = encode_nodes(Encoder(), nodes, stamp=False)
+    loop_s = time.perf_counter() - t0
+    best = float("inf")
+    for _ in range(3):
+        enc = Encoder()
+        t0 = time.perf_counter()
+        t_stamp = encode_nodes(enc, nodes, stamp=True)
+        best = min(best, time.perf_counter() - t0)
+    assert loop_s / best >= 10.0, f"stamped {best:.3f}s vs loop {loop_s:.3f}s"
+    for f in _STAMP_FIELDS:
+        assert (
+            np.asarray(getattr(t_loop, f)).tobytes()
+            == np.asarray(getattr(t_stamp, f)).tobytes()
+        ), f"field {f} diverged at 20k nodes"
+
+
+# ---------------------------------------------------------------------------
+# 2-D (scenarios, nodes) mesh: digest-identical, less HBM per device
+# ---------------------------------------------------------------------------
+
+
+def _mesh_or_skip(s_devs, n_devs):
+    from open_simulator_tpu.parallel.mesh import product_mesh_2d
+
+    if len(jax.devices()) < s_devs * n_devs:
+        pytest.skip(f"needs {s_devs * n_devs} devices")
+    return product_mesh_2d(s_devs, n_devs)
+
+
+@pytest.mark.parametrize("s_devs,n_devs", [(2, 1), (1, 2), (2, 2), (2, 4)])
+def test_2d_mesh_sweep_is_digest_identical(s_devs, n_devs):
+    mesh = _mesh_or_skip(s_devs, n_devs)
+    cluster, apps = overflow_fixture()
+    scenarios = [
+        Scenario(name="tiny", node_count=2),
+        Scenario(name="half", node_count=3),
+        Scenario(name="most", node_count=5),
+        Scenario(name="all"),
+    ]
+    reset_name_rng()
+    ref = simulate_batch(cluster, apps, scenarios)
+    reset_name_rng()
+    cluster2, apps2 = overflow_fixture()
+    sharded = simulate_batch(cluster2, apps2, scenarios, mesh=mesh)
+    assert [digest(r) for r in sharded] == [digest(r) for r in ref]
+
+
+def test_2d_mesh_shards_node_tables_across_hbm():
+    """Sharding the node axis must actually cut per-device bytes vs the
+    replicated layout (the reason the 2-D mesh exists)."""
+    from open_simulator_tpu.parallel.mesh import (
+        hbm_bytes_per_device,
+        node_sharding,
+        product_mesh_2d,
+        replicated,
+    )
+    from open_simulator_tpu.ops.state import node_static_from_table
+    from open_simulator_tpu.utils import metrics
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    mesh = product_mesh_2d(2, 4)
+    enc = Encoder()
+    nodes = [make_node(f"h-{i:04d}", cpu="8", memory="16Gi")
+             for i in range(512)]
+    ns = node_static_from_table(enc, encode_nodes(enc, nodes))
+
+    rep = hbm_bytes_per_device(jax.device_put(ns, replicated(mesh, ns)))
+    shd = hbm_bytes_per_device(jax.device_put(ns, node_sharding(mesh)))
+    assert max(shd.values()) < max(rep.values())
+    # the gauge snapshots the last call's layout
+    for dev, nbytes in shd.items():
+        assert metrics.HBM_BYTES_PER_DEVICE.value(device=dev) == nbytes
+
+
+# ---------------------------------------------------------------------------
+# capacity search stays on the ladder
+# ---------------------------------------------------------------------------
+
+
+def test_batched_capacity_sweep_compiles_only_ladder_rungs():
+    """Every scenario program key a batched capacity sweep touches must sit
+    on a ladder rung (node_bucket fixed point) with at most
+    SCENARIO_PROGRAMS_PER_BUCKET paddings per key — the <= 1 program per
+    rung guarantee that makes `simon warmup` able to pre-bank the sweep."""
+    from open_simulator_tpu.engine.capacity import plan_capacity
+    from open_simulator_tpu.engine.simulator import AppResource, ClusterResource
+    from open_simulator_tpu.ops.fast import (
+        reset_scenario_programs,
+        scenario_programs,
+    )
+    from tests.factories import make_deployment
+    from tests.test_batch_engine import HOSTNAME_ANTI
+
+    cluster = ClusterResource(
+        nodes=[make_node(f"base-{i}", cpu="32", memory="64Gi")
+               for i in range(2)]
+    )
+    apps = [
+        AppResource(
+            name="app",
+            objects=[
+                make_deployment(
+                    "lonely", replicas=40, cpu="500m", memory="1Gi",
+                    with_affinity=HOSTNAME_ANTI,
+                )
+            ],
+        )
+    ]
+    template = make_node("clone", cpu="32", memory="64Gi")
+    reset_scenario_programs()
+    reset_name_rng()
+    plan = plan_capacity(cluster, apps, template, sweep_mode="batched")
+    assert plan is not None and plan.batched_calls > 0
+    progs = scenario_programs()
+    assert progs, "batched sweep must record scenario programs"
+    for (n, _p), pads in progs.items():
+        assert node_bucket(n) == n, f"off-ladder node pad {n}"
+        assert len(pads) <= 2, f"paddings exploded for N={n}: {pads}"

@@ -84,13 +84,17 @@ def test_nested_trace_falls_through(monkeypatch):
     assert np.isnan(outer(jnp.float32(-1.0)))  # no raise
 
 
-def test_all_eighteen_entries_are_sanitizable():
-    from open_simulator_tpu.analysis.jaxpr_audit import REQUIRED_COVERAGE
+def test_all_audited_entries_are_sanitizable():
+    from open_simulator_tpu.analysis.jaxpr_audit import (
+        AUDIT_TARGETS,
+        REQUIRED_COVERAGE,
+    )
     from open_simulator_tpu.ops import delta, fast, grouped, kernels
 
     entries = sanitized_entries(delta, fast, grouped, kernels)
     assert set(REQUIRED_COVERAGE) <= set(entries)
-    assert len([e for e in entries if not e.startswith("test:")]) == 18
+    expected = sum(len(attrs) for attrs in AUDIT_TARGETS.values())
+    assert len([e for e in entries if not e.startswith("test:")]) == expected
 
 
 def test_trace_delegation_for_jaxpr_audit():
