@@ -1502,6 +1502,23 @@ def _hetero_template(name="new-node"):
     return template
 
 
+def _preflight_verdict(config):
+    """The statically machine-checked fits-in-HBM verdict that `simon
+    preflight --write-budgets` banked for ``config`` in the checked-in
+    budget book, or None. Lets the bench line carry the static
+    peak-HBM/collective proof next to the measured throughput without
+    recompiling anything here."""
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "budgets",
+        "preflight.json",
+    )
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f).get("verdicts", {}).get(config)
+    except (OSError, ValueError):
+        return None
+
+
 def _config_plan_scaled(n_pods, n_nodes):
     """Million-scale node axis (docs/performance.md, node-bucket ladder):
     one segment publishing the four acceptance numbers together —
@@ -1630,6 +1647,23 @@ def _config_plan_scaled(n_pods, n_nodes):
     off = [n for (n, _p) in progs if node_bucket(n) != n]
     if off:
         out["error"] = out.get("error") or f"off-ladder node paddings: {off}"
+
+    # --- static preflight verdict (budgets/preflight.json) ----------------
+    if (n_pods, n_nodes) == (1_000_000, 100_000):
+        vd = _preflight_verdict("plan_1m_100k")
+        if vd is not None:
+            out["preflight_ok"] = bool(vd.get("ok"))
+            out["preflight_peak_gib"] = vd.get("peak_gib")
+            out["preflight_mesh"] = vd.get("mesh")
+            out["preflight_node_table_sharded"] = vd.get(
+                "node_table_sharded"
+            )
+            if not vd.get("ok"):
+                out["error"] = out.get("error") or (
+                    "preflight verdict failed: plan_1m_100k does not fit "
+                    "per-device HBM (or node table replicated) — see "
+                    "`simon preflight`"
+                )
     return out
 
 

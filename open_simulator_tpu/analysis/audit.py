@@ -12,7 +12,13 @@ actually got traced), `simon audit` proves two semantic properties:
   the captured jaxprs of all registered jit entry points, proving mask
   outputs stay in {0, 1}, score plugins stay in [0, 100], and no NaN
   (e.g. the ``-inf * 0.0`` sentinel pattern) can reach a selection
-  primitive.
+  primitive;
+* **memory** (`analysis.hlo_audit`, opt-in via ``--memory``) — a compact
+  slice of the preflight matrix: every entry lowered at the canonical
+  rung on the meshes the host has devices for, collective census +
+  estimator cross-check included. The full rung × mesh × budget-diff
+  matrix (plus transfer guard and the plan_1m_100k verdict) lives under
+  ``simon preflight``.
 
 Both passes emit deterministic findings (stable sort keys, no wall-clock
 or randomness), so the JSON report is byte-identical across runs and
@@ -34,11 +40,14 @@ from .races import RaceAuditReport, run_races
 class SemanticAuditReport:
     races: Optional[RaceAuditReport]
     invariants: Optional[object]  # invariants.InvariantAudit (jax-importing)
+    memory: Optional[object] = None  # hlo_audit.PreflightReport
 
     @property
     def ok(self) -> bool:
-        return (self.races is None or self.races.ok) and (
-            self.invariants is None or self.invariants.ok
+        return (
+            (self.races is None or self.races.ok)
+            and (self.invariants is None or self.invariants.ok)
+            and (self.memory is None or self.memory.ok)
         )
 
     def to_dict(self) -> dict:
@@ -49,6 +58,9 @@ class SemanticAuditReport:
                 self.invariants.to_dict()
                 if self.invariants is not None
                 else None
+            ),
+            "memory": (
+                self.memory.to_dict() if self.memory is not None else None
             ),
         }
 
@@ -61,6 +73,8 @@ class SemanticAuditReport:
             parts.append(self.races.render_text())
         if self.invariants is not None:
             parts.append(self.invariants.render_text())
+        if self.memory is not None:
+            parts.append(self.memory.render_text())
         parts.append(f"audit: {'ok' if self.ok else 'FAILED'}")
         return "\n".join(parts)
 
@@ -68,13 +82,14 @@ class SemanticAuditReport:
 def run_semantic_audit(
     races: bool = True,
     invariants: bool = True,
+    memory: bool = False,
     package_root: Optional[str] = None,
     report_root: Optional[str] = None,
 ) -> SemanticAuditReport:
     """Run the requested passes. The race pass is pure-AST; the invariant
-    pass imports jax and traces the registered entries — callers that need
-    a deterministic platform should run ``ensure_platform()`` first (the
-    CLI does)."""
+    and memory passes import jax and trace/lower the registered entries —
+    callers that need a deterministic platform should run
+    ``ensure_platform()`` first (the CLI does)."""
     race_report = (
         run_races(package_root=package_root, report_root=report_root)
         if races
@@ -85,4 +100,16 @@ def run_semantic_audit(
         from .invariants import run_invariants
 
         inv_report = run_invariants()
-    return SemanticAuditReport(races=race_report, invariants=inv_report)
+    mem_report = None
+    if memory:
+        from .hlo_audit import N_CANON, run_preflight
+
+        # compact slice: canonical rung, whatever meshes fit the host's
+        # devices; no transfer execution, no verdict, no budget diff —
+        # those are `simon preflight` business
+        mem_report = run_preflight(
+            rungs=(N_CANON,), transfers=False, verdict=False,
+        )
+    return SemanticAuditReport(
+        races=race_report, invariants=inv_report, memory=mem_report
+    )

@@ -1,0 +1,287 @@
+"""Static HBM budgets: shape-arithmetic byte estimator + checked-in books.
+
+Two halves, both free of program execution:
+
+* **estimator** — per-device byte arithmetic over pytrees of anything
+  that carries ``.shape``/``.dtype`` (``jax.ShapeDtypeStruct`` avals,
+  real ``jax.Array``\\ s, numpy arrays). A leaf with a ``NamedSharding``
+  contributes ``shard_shape`` bytes to each device in its mesh; a leaf
+  without one is treated as replicated. This is the pre-materialization
+  twin of ``parallel.mesh.hbm_bytes_per_device`` (which sums *real*
+  shard buffers): the preflight auditor cross-checks the estimate
+  against ``compiled.memory_analysis()`` so the arithmetic can be
+  trusted before any buffer exists, and ``hbm_bytes_per_device`` falls
+  back to it for unmaterialized leaves.
+
+* **budget book** — the checked-in per-(entry, rung, mesh) record of
+  what each lowered program is allowed to cost: argument/output/temp/
+  peak bytes from ``memory_analysis()`` plus the collective census
+  (kind -> count, operand bytes). ``diff()`` compares a fresh
+  measurement against the book and reports violations; CI fails on any.
+  The only way to raise a budget is the explicit
+  ``simon preflight --write-budgets`` flow, which rewrites the book
+  from the measured matrix — a memory or collective regression can
+  never land silently.
+
+Keep this module import-light: stdlib + lazy jax, so budget diffs and
+book round-trips run without touching XLA.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Iterable, List, Optional
+
+BOOK_VERSION = 1
+
+#: Relative headroom a measurement may exceed its budget by before it is
+#: a violation. Absorbs jax-version alignment drift, not regressions.
+DEFAULT_TOLERANCE = 0.05
+#: Absolute slack added on top of the relative tolerance (bytes). Small
+#: programs live entirely inside alignment padding; 1 MiB keeps them
+#: from flapping while staying far below any real node-table leak.
+DEFAULT_SLACK_BYTES = 1 << 20
+
+
+# ---------------------------------------------------------------------------
+# shape-arithmetic estimator
+# ---------------------------------------------------------------------------
+
+def dtype_nbytes(dtype: Any) -> int:
+    import numpy as np
+
+    return int(np.dtype(dtype).itemsize)
+
+
+def leaf_nbytes(shape: Iterable[int], dtype: Any) -> int:
+    n = dtype_nbytes(dtype)
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def leaf_bytes_by_device(
+    leaf: Any, default_device: Optional[Any] = None
+) -> Dict[str, int]:
+    """Per-device bytes one array-like leaf will occupy once materialized.
+
+    With a sharding (``NamedSharding`` on an aval or array), each device
+    in the sharding's device set gets ``shard_shape`` bytes. Without one
+    the leaf is attributed whole to ``default_device`` (or dropped when
+    that is None — an unplaced aval has no device to charge).
+    """
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is None or dtype is None:
+        return {}
+    sharding = getattr(leaf, "sharding", None)
+    if sharding is not None and hasattr(sharding, "shard_shape"):
+        per = leaf_nbytes(sharding.shard_shape(tuple(shape)), dtype)
+        return {str(d): per for d in sharding.device_set}
+    if default_device is None:
+        return {}
+    return {str(default_device): leaf_nbytes(shape, dtype)}
+
+
+def estimate_bytes_by_device(
+    *trees: Any, default_device: Optional[Any] = None
+) -> Dict[str, int]:
+    """Sum :func:`leaf_bytes_by_device` over whole pytrees.
+
+    ``default_device`` defaults to ``jax.devices()[0]`` so unsharded
+    leaves land where jax would commit them; pass an explicit device (or
+    a plain string) to avoid importing jax.
+    """
+    import jax
+
+    if default_device is None:
+        default_device = jax.devices()[0]
+    out: Dict[str, int] = {}
+    for tree in trees:
+        for leaf in jax.tree.leaves(tree):
+            for dev, n in leaf_bytes_by_device(leaf, default_device).items():
+                out[dev] = out.get(dev, 0) + n
+    return out
+
+
+def estimate_max_bytes_per_device(
+    *trees: Any, default_device: Optional[Any] = None
+) -> int:
+    """The headline scalar: the worst per-device byte load of the trees."""
+    per = estimate_bytes_by_device(*trees, default_device=default_device)
+    return max(per.values(), default=0)
+
+
+# ---------------------------------------------------------------------------
+# budget book
+# ---------------------------------------------------------------------------
+
+def program_key(entry: str, rung: int, mesh: str) -> str:
+    """Canonical budget key, e.g. ``ops.fast:schedule_scenarios@r128@m2x2``."""
+    return f"{entry}@r{int(rung)}@m{mesh}"
+
+
+@dataclasses.dataclass
+class ProgramBudget:
+    """Per-device byte + collective envelope of one lowered program."""
+
+    peak_bytes: int
+    argument_bytes: int
+    output_bytes: int
+    temp_bytes: int
+    alias_bytes: int = 0
+    collectives: Dict[str, int] = dataclasses.field(default_factory=dict)
+    collective_bytes: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "peak_bytes": int(self.peak_bytes),
+            "argument_bytes": int(self.argument_bytes),
+            "output_bytes": int(self.output_bytes),
+            "temp_bytes": int(self.temp_bytes),
+            "alias_bytes": int(self.alias_bytes),
+            "collectives": {k: int(v) for k, v in sorted(self.collectives.items())},
+            "collective_bytes": int(self.collective_bytes),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ProgramBudget":
+        return cls(
+            peak_bytes=int(d["peak_bytes"]),
+            argument_bytes=int(d["argument_bytes"]),
+            output_bytes=int(d["output_bytes"]),
+            temp_bytes=int(d["temp_bytes"]),
+            alias_bytes=int(d.get("alias_bytes", 0)),
+            collectives=dict(d.get("collectives", {})),
+            collective_bytes=int(d.get("collective_bytes", 0)),
+        )
+
+
+@dataclasses.dataclass
+class BudgetViolation:
+    key: str
+    kind: str      # unbudgeted | memory | new-collective | collective-bytes
+    field: str     # which quantity tripped
+    measured: int
+    budget: int
+    message: str
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return f"{self.key}: {self.kind}: {self.message}"
+
+
+@dataclasses.dataclass
+class BudgetBook:
+    """The checked-in budget file (``budgets/preflight.json``)."""
+
+    programs: Dict[str, ProgramBudget] = dataclasses.field(default_factory=dict)
+    #: machine-checked verdicts (e.g. plan_1m_100k fits-in-HBM) written by
+    #: --write-budgets so bench/CI can surface them without recompiling
+    verdicts: Dict[str, dict] = dataclasses.field(default_factory=dict)
+    tolerance: float = DEFAULT_TOLERANCE
+    slack_bytes: int = DEFAULT_SLACK_BYTES
+    version: int = BOOK_VERSION
+
+    # -- persistence --------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return {
+            "version": self.version,
+            "tolerance": self.tolerance,
+            "slack_bytes": self.slack_bytes,
+            "programs": {
+                k: self.programs[k].to_dict() for k in sorted(self.programs)
+            },
+            "verdicts": {k: self.verdicts[k] for k in sorted(self.verdicts)},
+        }
+
+    def save(self, path: str) -> None:
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(self.to_dict(), fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+    @classmethod
+    def load(cls, path: str) -> "BudgetBook":
+        with open(path, "r", encoding="utf-8") as fh:
+            d = json.load(fh)
+        return cls(
+            programs={
+                k: ProgramBudget.from_dict(v)
+                for k, v in d.get("programs", {}).items()
+            },
+            verdicts=dict(d.get("verdicts", {})),
+            tolerance=float(d.get("tolerance", DEFAULT_TOLERANCE)),
+            slack_bytes=int(d.get("slack_bytes", DEFAULT_SLACK_BYTES)),
+            version=int(d.get("version", BOOK_VERSION)),
+        )
+
+    # -- diff ---------------------------------------------------------------
+
+    def _cap(self, budget: int) -> int:
+        return int(budget * (1.0 + self.tolerance)) + self.slack_bytes
+
+    def diff(self, measured: Dict[str, ProgramBudget]) -> List[BudgetViolation]:
+        """Violations of ``measured`` against this book.
+
+        * a measured program with no budget is ``unbudgeted`` (a new entry
+          / rung / mesh must be admitted via --write-budgets, consciously);
+        * any byte field above ``budget * (1 + tolerance) + slack`` is a
+          ``memory`` violation — shrinking is always fine;
+        * a collective kind with more instances than budgeted (absent kind
+          = 0) is ``new-collective``: a program that was collective-free
+          must stay collective-free;
+        * collective operand bytes above the byte cap is
+          ``collective-bytes`` (same count, fatter gathers).
+
+        Book entries absent from ``measured`` are NOT violations — partial
+        matrices (test subsets, --entries filters) diff only what they ran.
+        """
+        out: List[BudgetViolation] = []
+        for key in sorted(measured):
+            m = measured[key]
+            b = self.programs.get(key)
+            if b is None:
+                out.append(BudgetViolation(
+                    key=key, kind="unbudgeted", field="", measured=0, budget=0,
+                    message="no checked-in budget for this (entry, rung, mesh)"
+                            " — run `simon preflight --write-budgets` to"
+                            " admit it",
+                ))
+                continue
+            for field in ("peak_bytes", "argument_bytes", "output_bytes",
+                          "temp_bytes"):
+                mv = int(getattr(m, field))
+                bv = int(getattr(b, field))
+                if mv > self._cap(bv):
+                    out.append(BudgetViolation(
+                        key=key, kind="memory", field=field,
+                        measured=mv, budget=bv,
+                        message=f"{field} {mv} exceeds budget {bv} "
+                                f"(cap {self._cap(bv)})",
+                    ))
+            for kind in sorted(set(m.collectives) | set(b.collectives)):
+                mc = int(m.collectives.get(kind, 0))
+                bc = int(b.collectives.get(kind, 0))
+                if mc > bc:
+                    out.append(BudgetViolation(
+                        key=key, kind="new-collective", field=kind,
+                        measured=mc, budget=bc,
+                        message=f"{mc} {kind} op(s) vs {bc} budgeted — new "
+                                f"cross-device communication in this program",
+                    ))
+            if int(m.collective_bytes) > self._cap(int(b.collective_bytes)):
+                out.append(BudgetViolation(
+                    key=key, kind="collective-bytes", field="collective_bytes",
+                    measured=int(m.collective_bytes),
+                    budget=int(b.collective_bytes),
+                    message=f"collective operand bytes "
+                            f"{int(m.collective_bytes)} exceed budget "
+                            f"{int(b.collective_bytes)}",
+                ))
+        return out

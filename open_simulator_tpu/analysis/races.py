@@ -11,11 +11,14 @@ builds:
   2. **locks** — module-level ``threading.Lock()``/``RLock()``/
      ``Semaphore()``-style bindings;
   3. **thread roots** — methods of ``BaseHTTPRequestHandler`` subclasses,
-     ``threading.Thread(target=...)`` targets, ``executor.submit(fn, ...)``
-     work items (the extender wave engine's HTTP fan-out) and
-     ``signal.signal`` handlers, then everything reachable from them
-     through the call graph (with ``self.method`` resolution inside
-     classes).
+     ``threading.Thread(target=...)`` targets (including
+     ``target=self._method`` inside classes and nested-function targets),
+     ``executor.submit(fn, ...)`` work items (the extender wave engine's
+     HTTP fan-out) and ``signal.signal`` handlers, then everything
+     reachable from them through the call graph — ``self.method`` inside a
+     class, plus ``self.<attr>.<method>()`` hops across classes when the
+     method name is unique package-wide (the admission worker thread's
+     ``self._loop.run_forever()`` pulls ``SchedulerLoop`` into the audit).
 
 Any read-modify-write of a shared scalar (AugAssign, ``x = f(x)``, or a
 read + rebind pair in one function) and any container mutation
@@ -286,53 +289,108 @@ def _is_handler_class(cls: ast.ClassDef) -> bool:
     return False
 
 
+def _thread_target_exprs(node: ast.Call) -> Tuple[List[ast.expr], str]:
+    """The callable expressions a Call hands to another thread, if any."""
+    callee = _callee_name(node)
+    if callee == "Thread":
+        return (
+            [kw.value for kw in node.keywords if kw.arg == "target"],
+            "thread target",
+        )
+    if callee == "submit" and node.args:
+        # executor.submit(fn, ...) — ThreadPoolExecutor work items run on
+        # pool threads (the extender wave engine's HTTP fan-out); audit
+        # the submitted callable like a Thread target
+        return [node.args[0]], "executor task"
+    if callee == "signal" and len(node.args) >= 2:
+        return [node.args[1]], "signal handler"
+    if callee == "Timer" and len(node.args) >= 2:
+        return [node.args[1]], "timer thread"
+    return [], ""
+
+
+def _qualnames(mod: ModuleInfo) -> Dict[str, FunctionInfo]:
+    return {i.qualname: i for i in mod.functions.values()}
+
+
 def thread_roots(ctx: LintContext) -> Dict[Tuple[str, str], str]:
     """(module, qualname) -> human-readable root reason."""
     roots: Dict[Tuple[str, str], str] = {}
     for mod in ctx.modules.values():
+        quals = _qualnames(mod)
         # 1. request-handler methods run on server threads
         for node in ast.walk(mod.tree):
             if isinstance(node, ast.ClassDef) and _is_handler_class(node):
-                for info in mod.functions.values():
+                for info in quals.values():
                     if _class_of(info.qualname) == node.name:
                         roots[(mod.name, info.qualname)] = (
                             f"handler thread {mod.name}:{info.qualname}"
                         )
-        # 2. Thread(target=...) and signal.signal(..., handler)
+        # 2. module-scope resolution: plain names and module.attr targets
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.Call):
                 continue
-            callee = _callee_name(node)
-            target_exprs: List[ast.expr] = []
-            reason = ""
-            if callee == "Thread":
-                target_exprs = [
-                    kw.value for kw in node.keywords if kw.arg == "target"
-                ]
-                reason = "thread target"
-            elif callee == "submit" and node.args:
-                # executor.submit(fn, ...) — ThreadPoolExecutor work items
-                # run on pool threads (the extender wave engine's HTTP fan
-                # out); audit the submitted callable like a Thread target
-                target_exprs = [node.args[0]]
-                reason = "executor task"
-            elif callee == "signal" and len(node.args) >= 2:
-                target_exprs = [node.args[1]]
-                reason = "signal handler"
-            elif callee == "Timer" and len(node.args) >= 2:
-                target_exprs = [node.args[1]]
-                reason = "timer thread"
+            target_exprs, reason = _thread_target_exprs(node)
             for expr in target_exprs:
                 resolved = ctx.resolve_call(mod, expr)
                 if resolved is not None:
                     roots[resolved] = (
                         f"{reason} {resolved[0]}:{resolved[1]}"
                     )
+        # 3. enclosing-scope resolution: `Thread(target=self._worker_main)`
+        # inside a method roots the sibling method; `Thread(target=_worker)`
+        # inside a function roots the nested def (stored under its
+        # qualname, invisible to module-scope lookup)
+        for info in quals.values():
+            cls = _class_of(info.qualname)
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                target_exprs, reason = _thread_target_exprs(node)
+                for expr in target_exprs:
+                    qual = None
+                    if (
+                        cls
+                        and isinstance(expr, ast.Attribute)
+                        and isinstance(expr.value, ast.Name)
+                        and expr.value.id == "self"
+                        and f"{cls}.{expr.attr}" in quals
+                    ):
+                        qual = f"{cls}.{expr.attr}"
+                    elif (
+                        isinstance(expr, ast.Name)
+                        and f"{info.qualname}.{expr.id}" in quals
+                    ):
+                        qual = f"{info.qualname}.{expr.id}"
+                    if qual is not None:
+                        roots[(mod.name, qual)] = (
+                            f"{reason} {mod.name}:{qual}"
+                        )
     return roots
 
 
-def _calls_from(ctx: LintContext, mod: ModuleInfo,
-                info: FunctionInfo) -> Iterator[Tuple[str, str]]:
+def _method_index(ctx: LintContext) -> Dict[str, List[Tuple[str, str]]]:
+    """method name -> every (module, Class.method) in the package. Used to
+    chase ``self.<attr>.<method>()`` hops across classes (the scheduler
+    worker thread calling ``self._loop.run_forever()``): with no type
+    information, a hop is followed only when the method name is unique
+    package-wide — ambiguity means no resolution, never a guess."""
+    index: Dict[str, List[Tuple[str, str]]] = {}
+    for mod in ctx.modules.values():
+        for info in _qualnames(mod).values():
+            qual = info.qualname
+            if "." not in qual:
+                continue
+            index.setdefault(qual.rsplit(".", 1)[1], []).append(
+                (mod.name, qual)
+            )
+    return index
+
+
+def _calls_from(
+    ctx: LintContext, mod: ModuleInfo, info: FunctionInfo,
+    method_index: Optional[Dict[str, List[Tuple[str, str]]]] = None,
+) -> Iterator[Tuple[str, str]]:
     cls = _class_of(info.qualname)
     for node in ast.walk(info.node):
         if not isinstance(node, ast.Call):
@@ -341,15 +399,23 @@ def _calls_from(ctx: LintContext, mod: ModuleInfo,
         if resolved is not None:
             yield resolved
         f = node.func
-        if (
-            cls
-            and isinstance(f, ast.Attribute)
-            and isinstance(f.value, ast.Name)
-            and f.value.id == "self"
+        if not isinstance(f, ast.Attribute):
+            continue
+        if isinstance(f.value, ast.Name) and f.value.id == "self":
+            if cls:
+                sibling = f"{cls}.{f.attr}"
+                if any(i.qualname == sibling for i in mod.functions.values()):
+                    yield (mod.name, sibling)
+        elif (
+            method_index is not None
+            and isinstance(f.value, ast.Attribute)
+            and isinstance(f.value.value, ast.Name)
+            and f.value.value.id == "self"
         ):
-            sibling = f"{cls}.{f.attr}"
-            if any(i.qualname == sibling for i in mod.functions.values()):
-                yield (mod.name, sibling)
+            # self.<attr>.<method>() — cross-class hop, unique-name only
+            candidates = method_index.get(f.attr, [])
+            if len(candidates) == 1:
+                yield candidates[0]
 
 
 def audited_functions(
@@ -358,6 +424,7 @@ def audited_functions(
     """Thread-reachable closure of the roots, plus every function in a
     module that defines a root (main-thread code racing the handlers)."""
     audited: Dict[Tuple[str, str], str] = {}
+    index = _method_index(ctx)
     work = [(key, reason) for key, reason in sorted(roots.items())]
     while work:
         key, reason = work.pop()
@@ -372,7 +439,7 @@ def audited_functions(
         )
         if info is None:
             continue
-        for tgt in _calls_from(ctx, mod, info):
+        for tgt in _calls_from(ctx, mod, info, index):
             if tgt not in audited:
                 work.append((tgt, reason))
 

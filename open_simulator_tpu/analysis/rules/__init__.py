@@ -6,6 +6,7 @@ the contract they guard:
 * ``jit_contracts`` — static_argnames hashability, import-time jnp work
 * ``dtype``        — f32/i32 regime in ``ops/``
 * ``shapes``       — jit-entry shape args flow through bucketing helpers
+* ``device_sync``  — host loops feeding jit entries stay sync-free
 """
 
-from . import dtype, jit_contracts, purity, shapes  # noqa: F401
+from . import device_sync, dtype, jit_contracts, purity, shapes  # noqa: F401

@@ -708,9 +708,9 @@ class Simulator:
                     self._ns, self._carry, row, weights, fo,
                     self._extra_filters, self._extra_scores,
                 )
-                mask_np = np.asarray(mask)
-                score_np = np.asarray(score)
-                ff_np = np.asarray(first_fail)
+                mask_np, score_np, ff_np = jax.device_get(
+                    (mask, score, first_fail)
+                )
                 feasible = [
                     self.cluster.nodes[j] for j in range(n_nodes) if mask_np[j]
                 ]
@@ -782,10 +782,10 @@ class Simulator:
                 self._carry, take, vg_take, dev_take = commit_step(
                     self._ns, self._carry, row, jnp.int32(best_ni)
                 )
-                self._bind_placed(
-                    pod, best_ni, np.asarray(take), np.asarray(vg_take),
-                    np.asarray(dev_take),
+                take_np, vg_np, dev_np = jax.device_get(
+                    (take, vg_take, dev_take)
                 )
+                self._bind_placed(pod, best_ni, take_np, vg_np, dev_np)
                 scheduled += 1
             sp.meta["scheduled"] = scheduled
         progress(

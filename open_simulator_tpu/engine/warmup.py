@@ -15,7 +15,11 @@ over the full capacity sweep, see jaxpr_audit.warm_start_check).
 The registry is not a second list to keep in sync: `warmup_registry()`
 replays jaxpr_audit's capture pass, so the warmup set and the audit set
 are identical by construction (one entry per AUDIT_TARGETS attr), and a
-jit entry added without audit coverage fails both gates at once.
+jit entry added without audit coverage fails both gates at once. The
+same capture list feeds `simon preflight` (analysis/hlo_audit), which
+re-lowers every entry abstractly at each ladder rung × mesh shape for
+the static HBM/collective budget gate — so the warmup, audit, and
+preflight sets cannot drift apart either.
 
 Node-axis shapes come from the bucket ladder (ops.encode.node_bucket):
 the sweep rehearsal touches the same ladder rungs a production capacity
@@ -40,6 +44,7 @@ __all__ = [
     "EntryWarmup",
     "WarmupReport",
     "warmup_registry",
+    "registry_captures",
     "run_warmup",
 ]
 
@@ -139,6 +144,27 @@ def warmup_registry() -> List[Any]:
     from ..analysis.jaxpr_audit import _capture_calls
 
     return _capture_calls()
+
+
+def registry_captures(names: Any = None) -> List[Any]:
+    """`warmup_registry()` filtered to ``names`` (audit names like
+    ``"ops.fast:schedule_scenarios"``); ``None`` keeps everything.
+
+    Raises KeyError naming the misses so a preflight run asked for an
+    entry that no longer exists fails loudly instead of silently
+    auditing an empty matrix."""
+    caps = warmup_registry()
+    if names is None:
+        return caps
+    wanted = set(names)
+    got = [c for c in caps if c.name in wanted]
+    missing = wanted - {c.name for c in got}
+    if missing:
+        raise KeyError(
+            f"not in the capture registry: {sorted(missing)} "
+            f"(known: {sorted(c.name for c in caps)})"
+        )
+    return got
 
 
 def run_warmup(include_sweep: bool = True) -> WarmupReport:

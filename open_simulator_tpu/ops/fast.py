@@ -1609,11 +1609,14 @@ def schedule_batch_fast(
                     extra_filters, extra_scores,
                 )
                 sl = slice(start + done, start + done + n)
-                nodes_out[sl] = np.asarray(nodes)[:n]
-                reasons_out[sl] = np.asarray(reasons)[:n]
-                take_out[sl] = np.asarray(take)[:n]
-                vg_out[sl] = np.asarray(vg_take)[:n]
-                dev_out[sl] = np.asarray(dev_take)[:n]
+                nodes_np, reasons_np, take_np, vg_np, dev_np = jax.device_get(
+                    (nodes, reasons, take, vg_take, dev_take)
+                )
+                nodes_out[sl] = nodes_np[:n]
+                reasons_out[sl] = reasons_np[:n]
+                take_out[sl] = take_np[:n]
+                vg_out[sl] = vg_np[:n]
+                dev_out[sl] = dev_np[:n]
                 done += n
             continue
 
@@ -1686,7 +1689,8 @@ def schedule_batch_fast(
             # Domain-merge path: O(Dc) scan state instead of O(N). The class
             # partition needs the pod's spread eligibility on host (one small
             # bool[N] transfer per group).
-            elig_np = np.asarray(na_ok) & valid_np
+            # deliberate bool[N] fetch: the domain partition is planned on host
+            elig_np = np.asarray(na_ok) & valid_np  # osim: lint-ok[device-sync-in-loop]
             plan = _domain_plan(
                 batch.spread_topo[start], batch.aff_topo[start],
                 anti_topo_np, batch.match_anti[start], topo_np, valid_np,

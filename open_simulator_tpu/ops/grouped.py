@@ -364,10 +364,13 @@ def schedule_batch_grouped(
                 extra_filters, extra_scores,
             )
             sl = slice(start + done, start + done + n)
-            nodes_out[sl] = np.asarray(nodes)[:n]
-            reasons_out[sl] = np.asarray(reasons)[:n]
-            take_out[sl] = np.asarray(take)[:n]
-            vg_out[sl] = np.asarray(vg_take)[:n]
-            dev_out[sl] = np.asarray(dev_take)[:n]
+            nodes_np, reasons_np, take_np, vg_np, dev_np = jax.device_get(
+                (nodes, reasons, take, vg_take, dev_take)
+            )
+            nodes_out[sl] = nodes_np[:n]
+            reasons_out[sl] = reasons_np[:n]
+            take_out[sl] = take_np[:n]
+            vg_out[sl] = vg_np[:n]
+            dev_out[sl] = dev_np[:n]
             done += n
     return carry, nodes_out, reasons_out, take_out, vg_out, dev_out

@@ -220,21 +220,35 @@ def shard_scenarios_2d(
 
 
 def hbm_bytes_per_device(*trees) -> dict:
-    """Actual bytes resident per device for the given pytrees of jax.Arrays
-    — summed over each leaf's addressable shards, so a sharded layout
-    reports its true per-device footprint while a replicated layout reports
-    the full tensor on every device. Snapshots into the
-    osim_hbm_bytes_per_device gauge and returns {device: bytes}."""
+    """Bytes resident per device for the given pytrees — real or planned.
+
+    Materialized jax.Arrays are summed over each leaf's addressable
+    shards, so a sharded layout reports its true per-device footprint
+    while a replicated layout reports the full tensor on every device.
+    Leaves that are not materialized yet — ``jax.ShapeDtypeStruct`` avals
+    (with or without a sharding), numpy arrays — fall back to the static
+    shape-arithmetic estimator from ``analysis.budget``, which the
+    preflight auditor continuously cross-checks against
+    ``compiled.memory_analysis()``; the same call therefore answers both
+    "what is resident now" and "what will this tree cost once placed".
+    Snapshots into the osim_hbm_bytes_per_device gauge and returns
+    {device: bytes}."""
+    from ..analysis.budget import leaf_bytes_by_device
     from ..utils import metrics
 
+    default_dev = str(jax.devices()[0])
     out: dict = {}
     for tree in trees:
         for leaf in jax.tree.leaves(tree):
-            if not hasattr(leaf, "addressable_shards"):
-                continue
-            for shard in leaf.addressable_shards:
-                key = str(shard.device)
-                out[key] = out.get(key, 0) + int(shard.data.nbytes)
+            if hasattr(leaf, "addressable_shards"):
+                for shard in leaf.addressable_shards:
+                    key = str(shard.device)
+                    out[key] = out.get(key, 0) + int(shard.data.nbytes)
+            else:
+                for key, n in leaf_bytes_by_device(
+                    leaf, default_device=default_dev
+                ).items():
+                    out[key] = out.get(key, 0) + n
     for dev, nbytes in sorted(out.items()):
         metrics.HBM_BYTES_PER_DEVICE.set(nbytes, device=dev)
     return out

@@ -344,6 +344,114 @@ def test_unbucketed_shape_wrapper_propagation(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# device-sync-in-loop
+
+
+def test_device_sync_in_loop_true_positive(tmp_path):
+    r = _lint(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def kern(x):
+            return x * 2
+
+        def drive(xs):
+            acc = []
+            for x in xs:
+                y = kern(x)
+                acc.append(np.asarray(y))
+                y.block_until_ready()
+            return acc
+        """,
+    )
+    hits = [f for f in r.active if f.rule == "device-sync-in-loop"]
+    assert len(hits) == 2
+    assert any("np.asarray" in f.message for f in hits)
+    assert any("block_until_ready" in f.message for f in hits)
+
+
+def test_device_sync_near_miss_host_numpy_and_epilogue(tmp_path):
+    """Coercing genuine numpy state in the loop is host arithmetic, and a
+    one-shot sync after the loop is the blessed shape — neither flags."""
+    r = _lint(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def kern(x):
+            return x * 2
+
+        def drive(xs, hosts):
+            outs = []
+            for x, h in zip(xs, hosts):
+                outs.append(kern(x))
+                total = float(np.sum(h))  # host state, not a jit result
+            return np.asarray(outs[-1]), total
+        """,
+    )
+    assert "device-sync-in-loop" not in _rule_ids(r)
+
+
+def test_device_sync_near_miss_consolidated_device_get(tmp_path):
+    """One jax.device_get over the batch is the idiom the rule pushes
+    toward; a host-returning wrapper that fetches internally is likewise
+    not jit-ish, so loops around it are free to coerce its results."""
+    r = _lint(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def kern(x):
+            return x * 2
+
+        def kern_host(x):
+            return jax.device_get(kern(x))
+
+        def drive(xs):
+            outs = [kern(x) for x in xs]
+            fetched = []
+            for x in xs:
+                y = kern_host(x)
+                fetched.append(float(np.sum(y)))
+            return jax.device_get(outs), fetched
+        """,
+    )
+    assert "device-sync-in-loop" not in _rule_ids(r)
+
+
+def test_device_sync_suppression_escape(tmp_path):
+    """A deliberate per-iteration sync takes the standard comment escape."""
+    r = _lint(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def kern(x):
+            return x * 2
+
+        def drive(xs):
+            for x in xs:
+                y = kern(x)
+                # the mask gates the next dispatch; the sync is the point
+                m = np.asarray(y)  # osim: lint-ok[device-sync-in-loop]
+                if not m.any():
+                    break
+        """,
+    )
+    assert "device-sync-in-loop" not in _rule_ids(r)
+    assert sum(f.suppressed for f in r.findings) == 1
+
+
+# ---------------------------------------------------------------------------
 # engine machinery
 
 

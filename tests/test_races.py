@@ -152,6 +152,139 @@ def test_cross_module_shared_state(tmp_path):
     assert [f.state for f in rep.active] == ["pkg.store.table"]
 
 
+def test_self_method_thread_target_is_rooted(tmp_path):
+    """Thread(target=self._method) inside a class resolves the sibling
+    method as a root (the AdmissionQueue._worker_main shape)."""
+    rep = _races(
+        tmp_path,
+        """
+    import threading
+
+    _pending = []
+
+    class Q:
+        def start(self):
+            threading.Thread(target=self._worker_main).start()
+
+        def _worker_main(self):
+            _pending.append(1)
+    """,
+    )
+    assert [f.state for f in rep.active] == ["pkg.mod._pending"]
+    assert "Q._worker_main" in rep.active[0].thread_root
+
+
+def test_nested_function_thread_target_is_rooted(tmp_path):
+    """A def nested inside the spawning function (the guarded_call._worker
+    shape) is resolved via its enclosing scope."""
+    rep = _races(
+        tmp_path,
+        """
+    import threading
+
+    _done = []
+
+    def guarded_call(fn):
+        def _worker():
+            _done.append(fn())
+
+        threading.Thread(target=_worker).start()
+    """,
+    )
+    assert [f.state for f in rep.active] == ["pkg.mod._done"]
+    assert "guarded_call._worker" in rep.active[0].thread_root
+
+
+def test_executor_submit_target_is_rooted(tmp_path):
+    rep = _races(
+        tmp_path,
+        """
+    from concurrent.futures import ThreadPoolExecutor
+
+    _results = []
+
+    def job(x):
+        _results.append(x)
+
+    def run(pool: ThreadPoolExecutor):
+        pool.submit(job, 1)
+    """,
+    )
+    assert [f.state for f in rep.active] == ["pkg.mod._results"]
+    assert "executor task" in rep.active[0].thread_root
+
+
+def test_cross_class_attribute_call_is_audited(tmp_path):
+    """self.<attr>.<method>() hops into the attribute's class when the
+    method name is unique package-wide (the SchedulerLoop.run_forever ->
+    session.take_pack shape). The callee lives in another module, so only
+    the hop — not the root-module blanket audit — can reach it."""
+    rep = _races(
+        tmp_path,
+        """
+    import threading
+    from .sess import Session
+
+    class Loop:
+        def __init__(self):
+            self.session = Session()
+
+        def run_forever(self):
+            self.session.take_pack_unique()
+
+        def start(self):
+            threading.Thread(target=self.run_forever).start()
+    """,
+        extra_modules={
+            "sess": """
+    _packs = []
+
+    class Session:
+        def take_pack_unique(self):
+            _packs.append(1)
+    """,
+        },
+    )
+    assert [f.state for f in rep.active] == ["pkg.sess._packs"]
+
+
+def test_ambiguous_method_name_not_resolved(tmp_path):
+    """Two classes defining the same method name => the self.<attr>.m()
+    hop stays unresolved (no guessing), so the other-module mutation is
+    unreachable."""
+    rep = _races(
+        tmp_path,
+        """
+    import threading
+    from .sess import A
+
+    class Loop:
+        def __init__(self):
+            self.session = A()
+
+        def run_forever(self):
+            self.session.step()
+
+        def start(self):
+            threading.Thread(target=self.run_forever).start()
+    """,
+        extra_modules={
+            "sess": """
+    _packs = []
+
+    class A:
+        def step(self):
+            _packs.append(1)
+
+    class B:
+        def step(self):
+            pass
+    """,
+        },
+    )
+    assert rep.ok, rep.render_text()
+
+
 # ---------------------------------------------------------------------------
 # near-miss negatives
 # ---------------------------------------------------------------------------
@@ -287,6 +420,16 @@ def test_installed_package_has_no_unguarded_races():
     # the audit actually looked at the threaded surface
     assert rep.audited_functions > 0
     assert any("do_POST" in r or "do_GET" in r for r in rep.thread_roots)
+
+
+def test_package_thread_roots_cover_workers_and_watchdog():
+    """The enclosing-scope pass must root the admission worker (a
+    Thread(target=self._worker_main) sibling) and the watchdog's nested
+    _worker def — the two shapes the module-scope pass used to miss."""
+    rep = run_races()
+    roots = "\n".join(rep.thread_roots)
+    assert "AdmissionQueue._worker_main" in roots, roots
+    assert "guarded_call._worker" in roots, roots
 
 
 def test_known_good_guarded_modules_not_flagged():
