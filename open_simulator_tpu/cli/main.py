@@ -511,6 +511,15 @@ def _run_chaos(args) -> int:
         )
     except (ApplyError, ValueError, OSError) as e:
         aborted = str(e)
+        # chaos abort = the run died under injected faults: dump the flight
+        # recorder so the abort leaves the same post-mortem artifact a real
+        # crash would (utils/flightrec.py)
+        try:
+            from ..utils import flightrec
+
+            flightrec.dump("chaos-abort", error=aborted)
+        except Exception:
+            pass
     finally:
         faults.uninstall_plan()
 
@@ -799,6 +808,95 @@ def _run_warmup(args) -> int:
     return 0 if result.ok else 1
 
 
+def _add_profile(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "profile",
+        help="capture a device trace and/or run the dispatch-gap analyzer",
+        description=(
+            "Device-time profiling (docs/observability.md). With a trailing "
+            "simon command (`simon profile -- apply -f cfg.yaml`), run it "
+            "under a jax.profiler device trace written to --out "
+            "(Perfetto/TensorBoard-loadable). Without a command — or with "
+            "--gaps — time every audited jit entry at its canonical shapes "
+            "with the block_until_ready sandwich and report per-entry "
+            "device time plus the dispatch-gap ratio (the fraction of wall "
+            "time the device sat idle waiting for the host), published as "
+            "osim_device_time_seconds / osim_dispatch_gap_ratio."
+        ),
+    )
+    p.add_argument(
+        "--out", default="",
+        help="device-trace output directory (default: "
+        "<runs root>/device-profile)",
+    )
+    p.add_argument(
+        "--gaps", action="store_true",
+        help="also run the dispatch-gap analyzer after the traced command "
+        "(implied when no command is given)",
+    )
+    p.add_argument(
+        "--entries", default="",
+        help="comma-separated audit entry names to analyze (default: every "
+        "registry entry; names as in `simon audit`)",
+    )
+    p.add_argument(
+        "--repeats", type=int, default=3,
+        help="timed repeats per entry, keeping the fastest (default 3)",
+    )
+    p.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output format (json is the machine-readable artifact)",
+    )
+    p.add_argument(
+        "cmd", nargs=argparse.REMAINDER, metavar="command",
+        help="simon command to run under the device trace, e.g. "
+        "`simon profile -- apply -f cfg.yaml`",
+    )
+
+
+def _run_profile(args) -> int:
+    import json as _json
+
+    from ..durable import default_runs_root
+    from ..utils.profiling import analyze_dispatch_gaps, capture_device_trace
+
+    cmd = [c for c in args.cmd if c != "--"]
+    report: dict = {}
+    rc = 0
+    if cmd:
+        out_dir = args.out or os.path.join(
+            default_runs_root(), "device-profile"
+        )
+        rc_box: list = []
+        report["trace"] = capture_device_trace(
+            out_dir, fn=lambda: rc_box.append(main(cmd))
+        )
+        rc = rc_box[0] if rc_box else 1
+        if not report["trace"].get("ok"):
+            rc = rc or 1
+    gaps = None
+    if args.gaps or not cmd:
+        names = [
+            s.strip() for s in args.entries.split(",") if s.strip()
+        ] or None
+        try:
+            gaps = analyze_dispatch_gaps(names=names, repeats=args.repeats)
+        except KeyError as e:
+            print(f"error: unknown audit entry {e}", file=sys.stderr)
+            return 1
+        report["dispatch_gaps"] = gaps.to_dict()
+    if args.format == "json":
+        print(_json.dumps(report, indent=2, sort_keys=True))
+    else:
+        if "trace" in report:
+            t = report["trace"]
+            state = "ok" if t.get("ok") else f"failed: {t.get('error')}"
+            print(f"device trace ({state}): {t.get('trace_dir')}")
+        if gaps is not None:
+            print(gaps.render_text())
+    return rc
+
+
 def _run_lint(args) -> int:
     import json as _json
 
@@ -857,6 +955,7 @@ def main(argv=None) -> int:
     _add_chaos(sub)
     _add_lint(sub)
     _add_preflight(sub)
+    _add_profile(sub)
     _add_runs(sub)
     _add_sweep(sub)
     _add_warmup(sub)
@@ -921,6 +1020,7 @@ def main(argv=None) -> int:
             ).strip()
     if args.command in (
         "apply", "chaos", "server", "runs", "sweep", "warmup", "preflight",
+        "profile",
     ):
         from ..utils.platform import enable_compilation_cache, ensure_platform
         from ..utils.tracing import init_logging
@@ -928,6 +1028,12 @@ def main(argv=None) -> int:
         init_logging()  # LogLevel env, parity: cmd/simon/simon.go:46-66
         ensure_platform()
         enable_compilation_cache()
+        # crash flight recorder: any unhandled crash of a device-touching
+        # command dumps the recent-span/metric/journal ring first
+        # (utils/flightrec.py; idempotent, safe under nested main() calls)
+        from ..utils import flightrec
+
+        flightrec.install_crash_hook()
     if args.command in ("apply", "server", "runs", "sweep"):
         # honor OSIM_FAULT_PLAN for non-chaos entry points too (chaos does
         # its own install): docs/resilience.md promises env-driven plans,
@@ -959,6 +1065,8 @@ def main(argv=None) -> int:
         return _run_sweep(args)
     if args.command == "warmup":
         return _run_warmup(args)
+    if args.command == "profile":
+        return _run_profile(args)
     if args.command == "gen-doc":
         return _gen_doc(parser, args.output_dir)
     if args.command == "server":

@@ -194,6 +194,16 @@ class RunJournal:
             self._seq += 1
             self._events.append(rec)
         metrics.JOURNAL_EVENTS.inc(event=event)
+        try:
+            # flight recorder breadcrumb (utils/flightrec.py): the event key
+            # + seq + current trace id, so a post-crash dump correlates its
+            # span ring to this journal's records. Never on the durability
+            # path — an import/ring failure cannot fail the append.
+            from ..utils import flightrec
+
+            flightrec.record_journal(event, rec["seq"], self.run_dir)
+        except Exception:
+            pass
         return rec
 
     def close(self) -> None:

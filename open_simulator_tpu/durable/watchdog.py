@@ -125,6 +125,20 @@ def guarded_call(
                 log.error("watchdog: %s exceeded %gs deadline", stage, deadline_s)
                 if journal is not None:
                     journal.append("watchdog", stage=stage, deadline_s=deadline_s)
+                try:
+                    # flight recorder (utils/flightrec.py): dump the
+                    # recent-span/metric/journal ring next to the journal —
+                    # the wedge evidence a post-mortem needs, captured at
+                    # the moment of the fire, never able to worsen it
+                    from ..utils import flightrec
+
+                    flightrec.dump(
+                        "watchdog",
+                        run_dir=getattr(journal, "run_dir", None),
+                        error=f"{stage} exceeded {deadline_s:g}s deadline",
+                    )
+                except Exception:
+                    pass
                 raise DeadlineExceeded(stage, deadline_s)
             done.wait(min(poll_s, max(remaining, 0.001)))
     if error:

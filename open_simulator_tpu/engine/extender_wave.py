@@ -47,7 +47,7 @@ from ..ops.kernels import commit_wave, probe_many
 from ..resilience import faults
 from ..utils import metrics
 from ..utils.httppool import configured_pool_size
-from ..utils.tracing import log
+from ..utils.tracing import activate, current_context, log, span
 from .extenders import (
     EXTENDER_SCORE_SCALE,
     ExtenderError,
@@ -137,10 +137,16 @@ def _run_chain(
     )
 
 
-def _chain_task(pod, feasible, interested) -> _ChainResult:
+def _chain_task(pod, feasible, interested, trace_ctx=None) -> _ChainResult:
+    """Pool-thread wrapper of one chain: re-activates the trace context
+    captured on the dispatching thread, so the chain's span (and every
+    extender-http child under it) stays a child-by-ID of the simulate call
+    that launched the wave."""
     metrics.EXTENDER_INFLIGHT.inc()
     try:
-        return _run_chain(pod, feasible, interested)
+        with activate(trace_ctx):
+            with span("extender-chain", pod=_pod_uid(pod)):
+                return _run_chain(pod, feasible, interested)
     finally:
         metrics.EXTENDER_INFLIGHT.dec()
 
@@ -211,6 +217,11 @@ def run_waves(
         w_pad = scenario_bucket(len(idx))
         return np.asarray(idx + [idx[0]] * (w_pad - len(idx)), np.int64)
 
+    # Captured ONCE on the simulate thread: every chain queued on the pool
+    # re-activates this context so its spans (and outbound traceparent
+    # headers) stay in the dispatching request's trace.
+    trace_ctx = current_context()
+
     with ThreadPoolExecutor(
         max_workers=workers, thread_name_prefix="osim-extender"
     ) as pool:
@@ -241,7 +252,8 @@ def run_waves(
                 )
                 futures.append(
                     pool.submit(
-                        _chain_task, pods[i], feasible, interested_by_pod[i]
+                        _chain_task, pods[i], feasible,
+                        interested_by_pod[i], trace_ctx,
                     )
                 )
             return _Wave(idx, wave_rows, mask, ff, mask_np, ff_np, futures)

@@ -36,7 +36,7 @@ from ..models.profiles import ExtenderConfig
 from ..resilience import faults
 from ..resilience.policy import RetryExhaustedError, RetryPolicy, breaker_for
 from ..utils import httppool, metrics
-from ..utils.tracing import log
+from ..utils.tracing import current_traceparent, log, span
 
 # framework.MaxNodeScore / extenderv1.MaxExtenderPriority (100 / 10)
 EXTENDER_SCORE_SCALE = 10.0
@@ -188,23 +188,40 @@ class HTTPExtender:
                         if eff is None
                         else min(eff, self.cfg.http_timeout_s)
                     )
+                # Both transports carry the W3C traceparent of whatever
+                # trace this worker thread is inside, so the extender's own
+                # telemetry can join the request's trace; the attempt
+                # itself is a child span, and the header names THAT span
+                # (the response "lands" under it). Outside any trace no
+                # header is sent — minting one nobody can correlate is
+                # noise — and the empty value tells the pool transport to
+                # skip its own injection (the extender-http span would
+                # otherwise look like an active trace to it).
+                headers = {"Content-Type": "application/json"}
+                traced = current_traceparent() is not None
                 if not httppool.keepalive_enabled():
                     # transport escape hatch (OSIM_EXTENDER_KEEPALIVE=0):
                     # one fresh connection per request; urlopen raises
                     # HTTPError on >= 400, handled below like fault-plan
                     # errors
-                    req = urllib.request.Request(
-                        url, data=data, method="POST",
-                        headers={"Content-Type": "application/json"},
-                    )
-                    with urllib.request.urlopen(req, timeout=eff) as resp:
-                        body = resp.read()
+                    with span("extender-http", verb=verb, url=url):
+                        if traced:
+                            headers["traceparent"] = current_traceparent()
+                        req = urllib.request.Request(
+                            url, data=data, method="POST", headers=headers,
+                        )
+                        with urllib.request.urlopen(req, timeout=eff) as resp:
+                            body = resp.read()
                 else:
                     pool, path = httppool.pool_for(url)
-                    status, reason, raw = pool.request(
-                        "POST", path, data,
-                        {"Content-Type": "application/json"}, eff,
-                    )
+                    with span("extender-http", verb=verb, url=url) as hs:
+                        headers["traceparent"] = (
+                            current_traceparent() if traced else ""
+                        )
+                        status, reason, raw = pool.request(
+                            "POST", path, data, headers, eff,
+                        )
+                        hs.meta["status"] = status
                     if status >= 400:
                         snippet = (
                             raw[:ERROR_BODY_SNIPPET_BYTES]

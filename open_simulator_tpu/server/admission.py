@@ -81,6 +81,7 @@ from ..durable.watchdog import (  # noqa: F401
 )
 from ..resilience import faults
 from ..utils import metrics
+from ..utils import tracing
 from ..utils.tracing import log
 
 # Serve-time defaults; the env knobs are resolved when the queue is
@@ -185,6 +186,13 @@ class Ticket:
     payload: Optional[dict] = None
     headers: Dict[str, str] = field(default_factory=dict)
     shed_reason: str = ""
+    # Trace context captured on the submitting (handler) thread so the
+    # scheduler loop can parent/link its pack span to the request's trace
+    # across the queue hop; pack_ctx is filled by the loop at execution
+    # time so the handler can link its root span to the pack that served
+    # the request (utils/tracing.py, docs/observability.md).
+    trace_ctx: Optional[Any] = None
+    pack_ctx: Optional[Any] = None
 
     def remaining_s(self, now: float) -> Optional[float]:
         if self.deadline_at is None:
@@ -328,19 +336,26 @@ class AdmissionQueue:
         deadline_ms: Optional[float] = None,
         op: str = "submit",
         fence_epoch: Optional[int] = None,
+        trace_ctx: Optional[Any] = None,
     ) -> Ticket:
         """Admit, or immediately shed, one request. Never blocks.
         `fence_epoch` is the live-snapshot generation the caller keyed the
-        request under (None = the request is not generation-dependent)."""
+        request under (None = the request is not generation-dependent).
+        `trace_ctx` pins the trace the ticket belongs to; defaults to the
+        calling thread's current trace context, so the queue hop to the
+        scheduler loop does not sever the request's trace."""
         now = self._clock()
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
+        if trace_ctx is None:
+            trace_ctx = tracing.current_context()
         ticket = Ticket(
             body=body,
             key=key if key is not None else coalesce_key("", body),
             enqueued_at=now,
             deadline_at=(now + deadline_ms / 1000.0) if deadline_ms > 0 else None,
             fence_epoch=fence_epoch,
+            trace_ctx=trace_ctx,
         )
         rule = faults.maybe_inject("admission", op)
         with self._cv:

@@ -48,6 +48,7 @@ from typing import Dict, List, Optional
 
 from ..resilience import faults
 from ..utils import metrics
+from ..utils import tracing
 from . import admission as admission_mod
 
 
@@ -190,8 +191,26 @@ class SchedulerLoop:
         now = t_iter
         for t in pack:
             metrics.PACK_LATENCY.observe(max(now - t.enqueued_at, 0.0))
+        # Cross-thread trace stitching: the pack runs on the loop thread,
+        # but every ticket carries the trace context of its submitting
+        # request. The pack's execution span is parented (by ID) on the
+        # FIRST ticket's trace and records span *links* to every other
+        # lane's context — one span cannot have N parents, so extra lanes
+        # become links, and each handler links back via ticket.pack_ctx.
+        ctx0 = next(
+            (t.trace_ctx for t in pack if t.trace_ctx is not None), None
+        )
         try:
-            self._run_pack_inner(pack, now)
+            with tracing.activate(ctx0):
+                with tracing.span("loop-pack", lanes=len(pack)) as s:
+                    for t in pack:
+                        t.pack_ctx = s.context()
+                        if (
+                            t.trace_ctx is not None
+                            and t.trace_ctx is not ctx0
+                        ):
+                            s.add_link(t.trace_ctx)
+                    self._run_pack_inner(pack, now)
         finally:
             self._last_pack_lanes = len(pack)
             self._last_pack_end = q._clock()

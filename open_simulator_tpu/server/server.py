@@ -675,8 +675,13 @@ def _submit_job(body: dict):
                 "job": job_id,
                 "status_url": f"/v1/jobs/{job_id}",
             }
+        from ..utils import tracing
+
         t = threading.Thread(
-            target=_run_job, args=(job_id, run_dir, body),
+            # the job thread outlives the POST that spawned it; the captured
+            # trace context keeps its journal/sweep spans in the same trace
+            target=_run_job,
+            args=(job_id, run_dir, body, tracing.current_context()),
             name=f"osim-job-{job_id}", daemon=True,
         )
         _jobs[job_id] = {"thread": t, "run_dir": run_dir, "error": None}
@@ -688,63 +693,19 @@ def _submit_job(body: dict):
     }
 
 
-def _run_job(job_id: str, run_dir: str, body: dict) -> None:
+def _run_job(job_id: str, run_dir: str, body: dict, trace_ctx=None) -> None:
     """Job worker thread: a journaled capacity sweep. Every phase of the
     batched ladder lands as a `sweep` record (plan_capacity journals them),
-    which is what GET /v1/jobs/<id> streams back as progress."""
-    import json as _json
-
-    from ..durable import RunJournal, atomic_write
-    from ..engine.apply import placement_digest
-    from ..engine.capacity import plan_capacity
+    which is what GET /v1/jobs/<id> streams back as progress. The trace
+    context captured at submit time keeps the job's spans in the same
+    trace as the POST /v1/jobs request that launched it."""
+    from ..utils import tracing
     from ..utils.tracing import log
 
     outcome = "failed"
     try:
-        cluster, apps = _request_cluster_apps(body)
-        new_node = Node.from_dict(body["newNode"])
-        resume = bool(body.get("resume"))
-        use_greed = bool(body.get("useGreed"))
-        with RunJournal.open(run_dir) as journal:
-            if resume:
-                journal.append("run_resume")
-            else:
-                journal.append(
-                    "run_start", kind="sweep", job=job_id, use_greed=use_greed,
-                )
-            plan = plan_capacity(
-                cluster, apps, new_node, use_greed=use_greed,
-                journal=journal, resume=resume, sweep_mode="batched",
-            )
-            journal.append(
-                "run_end",
-                outcome="ok" if plan is not None else "does_not_fit",
-                nodes_added=plan.nodes_added if plan else -1,
-            )
-            # timestamp-free snapshot, byte-identical across crash-resume
-            # (mirrors `simon sweep --capacity --run-dir`, cli/main.py)
-            atomic_write(
-                os.path.join(run_dir, "outcome.json"),
-                _json.dumps(
-                    {
-                        "outcome": "ok" if plan else "does_not_fit",
-                        "kind": "sweep",
-                        "nodes_added": plan.nodes_added if plan else -1,
-                        "attempts": plan.attempts if plan else 0,
-                        "batched_calls": plan.batched_calls if plan else 0,
-                        "retries": plan.retries if plan else 0,
-                        "unscheduled": (
-                            len(plan.result.unscheduled) if plan else -1
-                        ),
-                        "placement_digest": (
-                            placement_digest(plan.result) if plan else ""
-                        ),
-                    },
-                    indent=2,
-                    sort_keys=True,
-                )
-                + "\n",
-            )
+        with tracing.activate(trace_ctx), tracing.span("job", job=job_id):
+            _run_job_inner(job_id, run_dir, body)
         outcome = "completed"
     except Exception as e:
         log.warning("job %s failed", job_id, exc_info=True)
@@ -753,6 +714,59 @@ def _run_job(job_id: str, run_dir: str, body: dict) -> None:
             if ent is not None:
                 ent["error"] = str(e)
     metrics.JOBS.inc(outcome=outcome)
+
+
+def _run_job_inner(job_id: str, run_dir: str, body: dict) -> None:
+    import json as _json
+
+    from ..durable import RunJournal, atomic_write
+    from ..engine.apply import placement_digest
+    from ..engine.capacity import plan_capacity
+
+    cluster, apps = _request_cluster_apps(body)
+    new_node = Node.from_dict(body["newNode"])
+    resume = bool(body.get("resume"))
+    use_greed = bool(body.get("useGreed"))
+    with RunJournal.open(run_dir) as journal:
+        if resume:
+            journal.append("run_resume")
+        else:
+            journal.append(
+                "run_start", kind="sweep", job=job_id, use_greed=use_greed,
+            )
+        plan = plan_capacity(
+            cluster, apps, new_node, use_greed=use_greed,
+            journal=journal, resume=resume, sweep_mode="batched",
+        )
+        journal.append(
+            "run_end",
+            outcome="ok" if plan is not None else "does_not_fit",
+            nodes_added=plan.nodes_added if plan else -1,
+        )
+        # timestamp-free snapshot, byte-identical across crash-resume
+        # (mirrors `simon sweep --capacity --run-dir`, cli/main.py)
+        atomic_write(
+            os.path.join(run_dir, "outcome.json"),
+            _json.dumps(
+                {
+                    "outcome": "ok" if plan else "does_not_fit",
+                    "kind": "sweep",
+                    "nodes_added": plan.nodes_added if plan else -1,
+                    "attempts": plan.attempts if plan else 0,
+                    "batched_calls": plan.batched_calls if plan else 0,
+                    "retries": plan.retries if plan else 0,
+                    "unscheduled": (
+                        len(plan.result.unscheduled) if plan else -1
+                    ),
+                    "placement_digest": (
+                        placement_digest(plan.result) if plan else ""
+                    ),
+                },
+                indent=2,
+                sort_keys=True,
+            )
+            + "\n",
+        )
 
 
 def _job_status(job_id: str, after: int):
@@ -962,7 +976,17 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
-        for name, value in (headers or {}).items():
+        headers = dict(headers or {})
+        # Every traced response echoes its trace id, so a client (or a
+        # human with curl) can find the request's spans in the trace
+        # export / flight recorder without guessing.
+        if "X-Osim-Trace-Id" not in headers:
+            from ..utils import tracing
+
+            tid = tracing.current_trace_id()
+            if tid is not None:
+                headers["X-Osim-Trace-Id"] = tid
+        for name, value in headers.items():
             self.send_header(name, value)
         self.end_headers()
         self.wfile.write(data)
@@ -991,6 +1015,24 @@ class _Handler(BaseHTTPRequestHandler):
             from ..utils.tracing import recent_timings
 
             self._send(200, {"timings": recent_timings()})
+        elif self.path.startswith("/debug/profile"):
+            # Device-time profiling (utils/profiling.py): capture a
+            # jax.profiler trace for ?ms=N (default 1000, capped) into the
+            # runs root, Perfetto/TensorBoard-loadable. Distinct from
+            # /debug/pprof/profile, which samples HOST thread stacks.
+            from urllib.parse import parse_qs, urlparse
+
+            from ..durable import default_runs_root
+            from ..utils.profiling import capture_device_trace
+
+            q = parse_qs(urlparse(self.path).query)
+            try:
+                ms = min(float(q.get("ms", ["1000"])[0]), 60_000.0)
+            except ValueError:
+                ms = 1000.0
+            out_dir = os.path.join(default_runs_root(), "device-profile")
+            report = capture_device_trace(out_dir, duration_ms=ms)
+            self._send(200 if report.get("ok") else 500, report)
         elif self.path.startswith("/debug/pprof/profile"):
             # CPU profile: sample every thread's stack at ~100 Hz for
             # ?seconds=N (default 2; capped), return aggregated stacks —
@@ -1015,6 +1057,7 @@ class _Handler(BaseHTTPRequestHandler):
                         "profile": "/debug/pprof/profile?seconds=N",
                         "cmdline": "/debug/pprof/cmdline",
                         "timings": "/debug/timings",
+                        "device": "/debug/profile?ms=N",
                         "metrics": "/metrics",
                     }
                 },
@@ -1050,6 +1093,24 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(404, {"error": "not found"})
 
     def do_POST(self):  # noqa: N802
+        # One request = one trace: the handler opens the request's root
+        # span here, continuing the caller's trace when the request
+        # carries a W3C `traceparent` header (utils/tracing.py). Tickets
+        # capture this context at submit, the scheduler loop re-activates
+        # it across the queue hop, and _send echoes the trace id back as
+        # X-Osim-Trace-Id (docs/observability.md).
+        from ..utils import tracing
+
+        remote = tracing.TraceContext.from_traceparent(
+            self.headers.get("traceparent")
+        )
+        with tracing.activate(remote):
+            with tracing.span(
+                "http-request", path=self.path, method="POST"
+            ) as root:
+                self._do_post_inner(root)
+
+    def _do_post_inner(self, root) -> None:
         if self.path not in ("/api/deploy-apps", "/api/scale-apps", "/v1/jobs"):
             self._send(404, {"error": "not found"})
             return
@@ -1115,6 +1176,11 @@ class _Handler(BaseHTTPRequestHandler):
             fence_epoch=fence_epoch,
         )
         queue.wait(ticket)
+        # Link (not parent) this root to the pack span that executed the
+        # ticket: the pack ran on the loop thread, possibly serving many
+        # lanes, so the relationship is a peer link in both directions.
+        if ticket.pack_ctx is not None:
+            root.add_link(ticket.pack_ctx)
         self._send(ticket.code, ticket.payload or {}, headers=ticket.headers)
 
     def log_message(self, fmt, *args):  # quiet gin-style access logs
@@ -1132,6 +1198,15 @@ def _graceful_shutdown(signum=None, frame=None) -> None:
         return
     name = signal.Signals(signum).name if signum is not None else "shutdown"
     print(f"simon server: received {name}, draining in-flight requests")
+    try:
+        # last-breath evidence: what the server was doing when it was told
+        # to die (utils/flightrec.py) — written before the drain starts so
+        # a kill -9 follow-up can't lose it
+        from ..utils import flightrec
+
+        flightrec.dump("sigterm")
+    except Exception:
+        pass
     threading.Thread(
         target=httpd.shutdown, name="osim-shutdown", daemon=True
     ).start()
@@ -1150,6 +1225,12 @@ def serve(
     global _kubeconfig, _master, _snapshot, _snapshot_at, _current_server
     global _resident, _snapshot_stale
     _resolve_env_config()
+    # Crash flight recorder: an unhandled exception on any thread dumps the
+    # recent-span/metric/journal ring before the process dies
+    # (utils/flightrec.py; idempotent).
+    from ..utils import flightrec
+
+    flightrec.install_crash_hook()
     _kubeconfig = kubeconfig or None
     _master = master
     # A previous serve() in this process may have cached a snapshot (and

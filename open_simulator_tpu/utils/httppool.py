@@ -141,7 +141,24 @@ class HTTPConnectionPool:
     ) -> Tuple[int, str, bytes]:
         """One round trip: (status, reason, response body). Transport
         failures raise OSError/http.client.HTTPException; the connection is
-        dropped from the pool so the next request dials fresh."""
+        dropped from the pool so the next request dials fresh.
+
+        When the calling thread is inside a trace (utils/tracing.py), the
+        request automatically carries the W3C `traceparent` header so the
+        far side can continue the same trace. An explicit header from the
+        caller wins; an explicitly EMPTY one suppresses the header entirely
+        (for callers that know the surrounding span is not a trace worth
+        propagating)."""
+        if "traceparent" not in headers:
+            from . import tracing
+
+            tp = tracing.current_traceparent()
+            if tp is not None:
+                headers = dict(headers)
+                headers["traceparent"] = tp
+        elif not headers["traceparent"]:
+            headers = dict(headers)
+            del headers["traceparent"]
         conn = self._checkout()
         try:
             try:

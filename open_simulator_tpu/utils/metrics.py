@@ -342,10 +342,16 @@ class MetricsRegistry:
         )
 
     def render(self) -> str:
-        """Prometheus text exposition format 0.0.4."""
+        """Prometheus text exposition format 0.0.4. The payload always ends
+        with exactly one trailing newline (each family render is
+        newline-terminated; an empty registry still yields "\\n") — the
+        EOF-safety the text format requires of scrapable exports."""
         with self._lock:
             families = [self._metrics[n] for n in sorted(self._metrics)]
-        return "".join(f.render() for f in families)
+        out = "".join(f.render() for f in families)
+        if not out.endswith("\n"):
+            out += "\n"
+        return out
 
     def snapshot(self, include_empty: bool = False) -> Dict[str, dict]:
         """JSON-friendly dump (embedded in bench.py output)."""
@@ -645,6 +651,22 @@ HBM_BYTES_PER_DEVICE = REGISTRY.gauge(
     "the 2-D (scenarios, nodes) mesh this stays ~1/node_devices of the "
     "replicated node-table footprint.",
     labelnames=("device",),
+)
+DEVICE_TIME = REGISTRY.gauge(
+    "osim_device_time_seconds",
+    "Device-side seconds of one warmed call of each audited jit entry, "
+    "from the dispatch-gap analyzer's block_until_ready sandwich "
+    "(utils/profiling.py): wall time between dispatch returning and the "
+    "result becoming ready.",
+    labelnames=("entry",),
+)
+DISPATCH_GAP = REGISTRY.gauge(
+    "osim_dispatch_gap_ratio",
+    "Host->device dispatch-gap fraction per audited jit entry: the share "
+    "of the entry's wall time spent in host-side dispatch (trace-cache "
+    "lookup, argument handling, enqueue) before the device could start — "
+    "the device-idle fraction the profiling layer exists to expose.",
+    labelnames=("entry",),
 )
 
 # Span names that map onto a dedicated kube-parity histogram; everything

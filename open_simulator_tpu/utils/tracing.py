@@ -21,6 +21,18 @@ trace events (load the file in Perfetto / chrome://tracing). Overhead when
 disabled is two clock reads per span — safe to leave in hot host paths
 (device time is measured as host wall time around blocking calls, which is
 what a user can act on).
+
+Cross-thread propagation: every span carries explicit trace/span IDs. A
+`TraceContext` snapshot of the active span (`current_context()`) can cross a
+queue or a thread-pool boundary and be re-activated on the far side with
+`activate(ctx)` — the next root span opened there becomes a *child by ID*
+of the captured span, so one request's work stays one connected trace even
+though each thread keeps its own span stack. The wire form is the W3C
+`traceparent` header (`TraceContext.to_traceparent` /
+`TraceContext.from_traceparent`); packed/coalesced lanes that share one
+execution record their relationship as span *links* (`Span.add_link`)
+instead of a parent edge. All IDs ride the Chrome-trace export as event
+args, so an exported file is reconnectable offline.
 """
 
 from __future__ import annotations
@@ -28,6 +40,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import re
 import threading
 import time
 from contextlib import contextmanager
@@ -54,8 +67,71 @@ def _history_max() -> int:
     return _HISTORY_DEFAULT
 
 
+def _new_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def _new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+class TraceContext:
+    """Immutable (trace_id, span_id) snapshot — the part of a span's
+    identity that can cross a thread, a queue, or a process boundary.
+    Captured at enqueue (`current_context()`), re-activated at dequeue
+    (`activate(ctx)`), and serialized on the wire as a W3C traceparent."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str) -> None:
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def __eq__(self, other: object) -> bool:
+        return (
+            isinstance(other, TraceContext)
+            and self.trace_id == other.trace_id
+            and self.span_id == other.span_id
+        )
+
+    def __hash__(self) -> int:
+        return hash((self.trace_id, self.span_id))
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"TraceContext({self.trace_id}, {self.span_id})"
+
+    def to_dict(self) -> dict:
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    # -- W3C traceparent (version 00, sampled flag always set) --------------
+
+    _TRACEPARENT_RE = re.compile(
+        r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
+    )
+
+    def to_traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    @classmethod
+    def from_traceparent(cls, header: Optional[str]) -> Optional["TraceContext"]:
+        """Parse a traceparent header; malformed/absent/all-zero IDs return
+        None (the request simply starts a fresh trace)."""
+        if not header:
+            return None
+        m = cls._TRACEPARENT_RE.match(header.strip().lower())
+        if m is None:
+            return None
+        version, trace_id, span_id = m.group(1), m.group(2), m.group(3)
+        if version == "ff" or trace_id == "0" * 32 or span_id == "0" * 16:
+            return None
+        return cls(trace_id, span_id)
+
+
 class Span:
-    __slots__ = ("name", "start", "end", "children", "meta")
+    __slots__ = (
+        "name", "start", "end", "children", "meta",
+        "trace_id", "span_id", "parent_id", "links",
+    )
 
     def __init__(self, name: str) -> None:
         self.name = name
@@ -63,17 +139,38 @@ class Span:
         self.end: Optional[float] = None
         self.children: List["Span"] = []
         self.meta: dict = {}
+        self.trace_id: str = ""
+        self.span_id: str = _new_span_id()
+        self.parent_id: Optional[str] = None
+        self.links: List[dict] = []
 
     @property
     def duration(self) -> float:
         return (self.end or time.time()) - self.start
+
+    def context(self) -> TraceContext:
+        return TraceContext(self.trace_id, self.span_id)
+
+    def add_link(self, ctx) -> None:
+        """Record a non-parent relationship to another span (a packed lane
+        pointing at its pack's execution span and vice versa). Accepts a
+        TraceContext or another Span."""
+        self.links.append(
+            {"trace_id": ctx.trace_id, "span_id": ctx.span_id}
+        )
 
     def to_dict(self) -> dict:
         d = {
             "name": self.name,
             "start": round(self.start, 6),
             "duration_s": round(self.duration, 4),
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
         }
+        if self.parent_id:
+            d["parent_id"] = self.parent_id
+        if self.links:
+            d["links"] = [dict(ln) for ln in self.links]
         if self.meta:
             d["meta"] = self.meta
         if self.children:
@@ -91,6 +188,9 @@ class Span:
 class _Tracer(threading.local):
     def __init__(self) -> None:
         self.stack: List[Span] = []
+        # remote parent context re-activated on this thread (activate());
+        # the next ROOT span opened here becomes its child by ID
+        self.remote: Optional[TraceContext] = None
 
 
 _tracer = _Tracer()
@@ -111,6 +211,15 @@ def span(name: str, **meta):
     parent = _tracer.stack[-1] if _tracer.stack else None
     if parent is not None:
         parent.children.append(s)
+        s.trace_id = parent.trace_id
+        s.parent_id = parent.span_id
+    elif _tracer.remote is not None:
+        # cross-thread continuation: a local root, but a child by ID of the
+        # context captured on the submitting thread
+        s.trace_id = _tracer.remote.trace_id
+        s.parent_id = _tracer.remote.span_id
+    else:
+        s.trace_id = _new_trace_id()
     _tracer.stack.append(s)
     try:
         yield s
@@ -119,14 +228,71 @@ def span(name: str, **meta):
         _tracer.stack.pop()
         metrics.observe_span(s.name, s.end - s.start)
         if parent is None:
+            root_dict = s.to_dict()
             with _history_lock:
-                _history.append(s.to_dict())
+                _history.append(root_dict)
                 del _history[:-_history_max()]
+            _record_flight(root_dict)
             _maybe_export_trace(s)
             if s.duration > SLOW_TRACE_S:
                 log.warning("slow trace (> %.1fs):\n%s", SLOW_TRACE_S, s.render())
             else:
                 log.debug("trace:\n%s", s.render())
+
+
+@contextmanager
+def activate(ctx: Optional[TraceContext]):
+    """Re-activate a captured TraceContext on the current thread: root spans
+    opened inside become children by ID of the captured span. `None` is a
+    no-op, so call sites can pass an optional context unconditionally."""
+    if ctx is None:
+        yield
+        return
+    prev = _tracer.remote
+    _tracer.remote = ctx
+    try:
+        yield
+    finally:
+        _tracer.remote = prev
+
+
+def current_span() -> Optional[Span]:
+    """The innermost open span on this thread, if any."""
+    return _tracer.stack[-1] if _tracer.stack else None
+
+
+def current_context() -> Optional[TraceContext]:
+    """Snapshot of the active span (or the re-activated remote context when
+    no span is open) for crossing a thread/queue boundary; None when this
+    thread is not inside any trace."""
+    if _tracer.stack:
+        return _tracer.stack[-1].context()
+    return _tracer.remote
+
+
+def current_trace_id() -> Optional[str]:
+    ctx = current_context()
+    return ctx.trace_id if ctx is not None else None
+
+
+def current_traceparent() -> Optional[str]:
+    """The W3C traceparent header for outbound HTTP, or None when the
+    calling thread is not inside any trace (never mints a fresh ID — a
+    header nobody can correlate is noise)."""
+    ctx = current_context()
+    return ctx.to_traceparent() if ctx is not None else None
+
+
+def _record_flight(root_dict: dict) -> None:
+    """Feed the finished root into the crash flight recorder (always-on
+    bounded ring, utils/flightrec.py). Lazy import: flightrec reads trace
+    IDs back through this module, so neither imports the other at top."""
+    try:
+        from . import flightrec
+
+        flightrec.record_span(root_dict)
+    except Exception:  # pragma: no cover - recorder must never break tracing
+        pass
 
 
 def recent_timings() -> List[dict]:
@@ -144,15 +310,36 @@ def recent_timings() -> List[dict]:
 # rewritten to the file — roots are rare (one per simulate call), so the
 # rewrite is cheap and the file is valid JSON after every root, even if the
 # process dies mid-run. Epoch microseconds stay below 2^53, so `ts` survives
-# the JSON double round trip.
+# the JSON double round trip. Every event carries its span's trace/span/
+# parent IDs (and links) as args, so the exported file stays one connected,
+# offline-reconnectable tree per request.
 
 _trace_lock = threading.Lock()
 _trace_events: List[dict] = []
-_TRACE_MAX_EVENTS = 250_000  # backstop for long-lived servers
+_TRACE_MAX_EVENTS = 250_000  # default backstop for long-lived servers
 _trace_overflow_logged = False
 
 
+def _trace_max_events() -> int:
+    """OSIM_TRACE_MAX_EVENTS overrides the 250k default event cap; read per
+    export so long-lived servers can be resized without a restart."""
+    raw = os.environ.get("OSIM_TRACE_MAX_EVENTS", "").strip()
+    if raw:
+        try:
+            return max(1, int(raw))
+        except ValueError:
+            log.warning("ignoring non-integer OSIM_TRACE_MAX_EVENTS=%r", raw)
+    return _TRACE_MAX_EVENTS
+
+
 def _span_events(s: Span, pid: int, tid: int, out: List[dict]) -> None:
+    args = dict(s.meta) if s.meta else {}
+    args["trace_id"] = s.trace_id
+    args["span_id"] = s.span_id
+    if s.parent_id:
+        args["parent_id"] = s.parent_id
+    if s.links:
+        args["links"] = [dict(ln) for ln in s.links]
     ev = {
         "name": s.name,
         "cat": "osim",
@@ -161,9 +348,8 @@ def _span_events(s: Span, pid: int, tid: int, out: List[dict]) -> None:
         "dur": max(s.duration, 0.0) * 1e6,
         "pid": pid,
         "tid": tid,
+        "args": args,
     }
-    if s.meta:
-        ev["args"] = dict(s.meta)
     out.append(ev)
     for c in s.children:
         _span_events(c, pid, tid, out)
@@ -177,16 +363,21 @@ def _maybe_export_trace(root: Span) -> None:
     events: List[dict] = []
     _span_events(root, os.getpid(), threading.get_ident(), events)
     with _trace_lock:
-        if len(_trace_events) + len(events) > _TRACE_MAX_EVENTS:
+        cap = _trace_max_events()
+        _trace_events.extend(events)
+        overflow = len(_trace_events) - cap
+        if overflow > 0:
+            # oldest-first rotation: the newest spans are the ones a live
+            # incident needs; the rotated-out prefix is already on disk in
+            # the previous rewrite anyway
+            del _trace_events[:overflow]
             if not _trace_overflow_logged:
                 _trace_overflow_logged = True
                 log.warning(
-                    "OSIM_TRACE_FILE: dropping events beyond %d; "
-                    "restart the process to start a fresh trace",
-                    _TRACE_MAX_EVENTS,
+                    "OSIM_TRACE_FILE: event cap %d reached; rotating oldest "
+                    "events out (set OSIM_TRACE_MAX_EVENTS to resize)",
+                    cap,
                 )
-            return
-        _trace_events.extend(events)
         payload = {"traceEvents": list(_trace_events),
                    "displayTimeUnit": "ms"}
         try:

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""CI observability smoke (docs/observability.md).
+
+Runs a small apply plus the dispatch-gap analyzer under OSIM_TRACE_FILE
+inside ONE root span, then proves the exported Chrome trace is a single
+connected tree:
+
+  * every event carries the same trace_id (one request = one trace);
+  * exactly one root event (no parent_id) — the smoke's own root span;
+  * every parent_id resolves to a span_id present in the file (no
+    orphans);
+  * both host spans (the apply/simulate phases) and device spans
+    (`device:<entry>` from the dispatch-gap analyzer) are present.
+
+Publishes the per-entry device-time table to the GitHub job summary when
+GITHUB_STEP_SUMMARY is set. Exits nonzero on any violation.
+"""
+
+import io
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+CONFIG = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "example", "simon-config.yaml",
+)
+
+
+def main() -> int:
+    out_dir = tempfile.mkdtemp(prefix="osim-obs-smoke-")
+    trace_path = os.path.join(out_dir, "trace.json")
+    os.environ["OSIM_TRACE_FILE"] = trace_path
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from open_simulator_tpu.api.config import SimonConfig
+    from open_simulator_tpu.engine.apply import run_apply
+    from open_simulator_tpu.utils.platform import ensure_platform
+    from open_simulator_tpu.utils.profiling import analyze_dispatch_gaps
+    from open_simulator_tpu.utils.tracing import span
+
+    ensure_platform()
+    cfg = SimonConfig.load(CONFIG)
+    with span("observability-smoke"):
+        run_apply(cfg, out=io.StringIO())
+        report = analyze_dispatch_gaps(repeats=1)
+
+    with open(trace_path) as fh:
+        events = json.load(fh)["traceEvents"]
+    assert events, "trace export produced no events"
+
+    trace_ids = {e["args"]["trace_id"] for e in events}
+    assert len(trace_ids) == 1, (
+        f"expected one connected trace, got {len(trace_ids)}: "
+        f"{sorted(trace_ids)}"
+    )
+    roots = [e for e in events if "parent_id" not in e["args"]]
+    assert len(roots) == 1, (
+        f"expected exactly one root span, got "
+        f"{[r['name'] for r in roots]}"
+    )
+    assert roots[0]["name"] == "observability-smoke", roots[0]["name"]
+    span_ids = {e["args"]["span_id"] for e in events}
+    orphans = [
+        e["name"] for e in events
+        if e["args"].get("parent_id") not in span_ids | {None}
+    ]
+    assert not orphans, f"orphaned spans (unresolvable parent_id): {orphans}"
+
+    device = sorted(
+        e["name"] for e in events if e["name"].startswith("device:")
+    )
+    host = sorted(
+        {e["name"] for e in events if not e["name"].startswith("device:")}
+    )
+    assert device, "no device:<entry> spans in the trace"
+    assert len(host) > 1, f"expected host phase spans beyond the root: {host}"
+    assert report.entries, "dispatch-gap analyzer timed no entries"
+
+    lines = [
+        "### observability smoke",
+        "",
+        f"- one connected trace: `{trace_ids.pop()}` "
+        f"({len(events)} spans, {len(device)} device, root "
+        f"`{roots[0]['name']}`)",
+        f"- aggregate dispatch-gap ratio: {report.dispatch_gap_ratio}",
+        "",
+        "| entry | device ms | dispatch ms | gap |",
+        "|---|---|---|---|",
+    ]
+    for e in sorted(report.entries, key=lambda e: -e.device_ms):
+        lines.append(
+            f"| {e.name} | {e.device_ms:.3f} | {e.dispatch_ms:.3f} "
+            f"| {e.gap_ratio:.3f} |"
+        )
+    summary = "\n".join(lines)
+    print(summary)
+    step_summary = os.environ.get("GITHUB_STEP_SUMMARY")
+    if step_summary:
+        with open(step_summary, "a") as fh:
+            fh.write(summary + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,11 +56,13 @@ class _StubExtender:
       keep all); victims echo back unchanged (as MetaVictims UIDs)
     - preempt_raw: full NodeNameToMetaVictims dict to return verbatim
       (overrides preempt_allow)
-    Records every request body in .calls."""
+    Records every request body in .calls and every request's headers (keys
+    lowercased) in .request_headers, index-aligned with .calls."""
 
     def __init__(self, behavior):
         self.behavior = behavior
         self.calls = []
+        self.request_headers = []
         stub = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -71,6 +73,9 @@ class _StubExtender:
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
                 stub.calls.append((self.path, body))
+                stub.request_headers.append(
+                    {k.lower(): v for k, v in self.headers.items()}
+                )
                 fail_first = stub.behavior.get("fail_first", 0)
                 if fail_first and len(stub.calls) <= fail_first:
                     self.send_response(503)
@@ -186,6 +191,18 @@ class _StubExtender:
     def close(self):
         self.server.shutdown()
         self.server.server_close()
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _flight_dumps_to_tmp(tmp_path_factory):
+    """Watchdog fires and crash hooks inside tests dump flight-recorder
+    artifacts; without a configured dir those land in the repo CWD. Point
+    them at a session tmp dir (tests that assert on dumps override it)."""
+    if not os.environ.get("OSIM_FLIGHT_DIR", "").strip():
+        os.environ["OSIM_FLIGHT_DIR"] = str(
+            tmp_path_factory.mktemp("flightrec")
+        )
+    yield
 
 
 @pytest.fixture(autouse=True)

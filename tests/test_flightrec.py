@@ -1,0 +1,171 @@
+"""Crash flight recorder (utils/flightrec.py): the always-on evidence ring
+and its dump triggers (watchdog, crash hooks), correlated by trace_id."""
+
+import json
+import threading
+import time
+import types
+
+import pytest
+
+from open_simulator_tpu.durable.journal import RunJournal
+from open_simulator_tpu.durable.watchdog import DeadlineExceeded, guarded_call
+from open_simulator_tpu.utils import flightrec, metrics, tracing
+from open_simulator_tpu.utils.tracing import span
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ring(monkeypatch, tmp_path):
+    monkeypatch.setenv("OSIM_FLIGHT_DIR", str(tmp_path / "flight"))
+    monkeypatch.delenv("OSIM_FLIGHT_EVENTS", raising=False)
+    flightrec.reset()
+    yield
+    flightrec.reset()
+
+
+def _dump_files(tmp_path):
+    d = tmp_path / "flight"
+    return sorted(d.glob("flightrec-*.json")) if d.is_dir() else []
+
+
+def test_root_span_close_feeds_the_ring():
+    with span("flight-probe", pods=3):
+        with span("inner"):
+            pass
+    evs = [e for e in flightrec.events() if e["kind"] == "span"]
+    assert evs, "root close did not reach the flight ring"
+    ev = evs[-1]
+    assert ev["name"] == "flight-probe"
+    assert ev["meta"]["pods"] == 3
+    assert len(ev["trace_id"]) == 32 and len(ev["span_id"]) == 16
+    # compact summary only — the subtree stays out of the ring
+    assert "children" not in ev
+
+
+def test_journal_append_records_correlated_breadcrumb(tmp_path):
+    j = RunJournal.open(str(tmp_path / "run"))
+    try:
+        with span("journaled-work") as s:
+            rec = j.append("probe-event", x=1)
+            trace_id = s.trace_id
+    finally:
+        j.close()
+    notes = [e for e in flightrec.events() if e["kind"] == "journal"]
+    assert notes, "journal append did not leave a breadcrumb"
+    note = notes[-1]
+    assert note["event"] == "probe-event"
+    assert note["seq"] == rec["seq"]           # joins against the WAL
+    assert note["run_dir"] == j.run_dir
+    assert note["trace_id"] == trace_id        # joins against the spans
+
+
+def test_ring_rotates_at_configured_size(monkeypatch):
+    monkeypatch.setenv("OSIM_FLIGHT_EVENTS", "4")
+    for i in range(9):
+        flightrec.note("probe", i=i)
+    evs = flightrec.events()
+    assert len(evs) == 4
+    assert [e["i"] for e in evs] == [5, 6, 7, 8]  # oldest rotated out
+
+
+def test_dump_artifact_structure(tmp_path):
+    metrics.JOURNAL_EVENTS.inc(event="flight-dump-probe")  # pre-baseline
+    flightrec.note("marker", detail="before")  # first record -> baseline
+    metrics.JOURNAL_EVENTS.inc(event="flight-dump-probe")
+    with span("dumped-span"):
+        pass
+    path = flightrec.dump("unit-test", error="synthetic")
+    assert path is not None
+    doc = json.loads(open(path).read())
+    assert doc["kind"] == "flight-recorder"
+    assert doc["reason"] == "unit-test"
+    assert doc["error"] == "synthetic"
+    assert doc["pid"]
+    kinds = {e["kind"] for e in doc["events"]}
+    assert {"marker", "span"} <= kinds
+    # events regrouped by trace: the untraced marker under "untraced", the
+    # span under its own 32-hex trace id
+    assert "untraced" in doc["traces"]
+    span_ev = [e for e in doc["events"] if e["kind"] == "span"][-1]
+    assert span_ev["trace_id"] in doc["traces"]
+    # only metrics that MOVED since the baseline appear, with the delta
+    fam = doc["metrics_delta"]["osim_journal_events_total"]
+    probe = [
+        s for s in fam if s["labels"] == {"event": "flight-dump-probe"}
+    ]
+    assert probe and probe[0]["value"] == 1
+
+
+def test_dump_filename_and_sequence(tmp_path):
+    p1 = flightrec.dump("unit-test")
+    p2 = flightrec.dump("unit-test")
+    assert p1 != p2
+    assert p1.endswith("-1.json") and p2.endswith("-2.json")
+    names = [p.name for p in _dump_files(tmp_path)]
+    assert all(n.startswith("flightrec-unit-test-") for n in names)
+
+
+def test_watchdog_fire_writes_flight_dump(tmp_path):
+    release = threading.Event()
+    try:
+        with pytest.raises(DeadlineExceeded):
+            guarded_call(
+                "flight-stage", lambda: release.wait(5.0), 0.05, poll_s=0.01
+            )
+    finally:
+        release.set()
+    dumps = [
+        p for p in _dump_files(tmp_path) if "watchdog" in p.name
+    ]
+    assert dumps, "watchdog fire did not dump the flight recorder"
+    doc = json.loads(dumps[-1].read_text())
+    assert doc["reason"] == "watchdog"
+    assert "flight-stage" in doc["error"]
+
+
+def test_crash_hooks_dump_once_and_chain(tmp_path, monkeypatch):
+    seen = []
+    monkeypatch.setattr(flightrec, "_prev_sys_hook",
+                        lambda *a: seen.append(a))
+    flightrec._sys_hook(RuntimeError, RuntimeError("boom"), None)
+    assert len(seen) == 1, "previous sys.excepthook was not chained"
+    dumps = [p for p in _dump_files(tmp_path) if "crash" in p.name]
+    assert len(dumps) == 1
+    assert "RuntimeError: boom" in json.loads(dumps[0].read_text())["error"]
+    # KeyboardInterrupt/SystemExit never trigger a dump (still chained)
+    flightrec._sys_hook(KeyboardInterrupt, KeyboardInterrupt(), None)
+    assert len([p for p in _dump_files(tmp_path) if "crash" in p.name]) == 1
+    assert len(seen) == 2
+
+
+def test_threading_hook_dumps(tmp_path, monkeypatch):
+    monkeypatch.setattr(flightrec, "_prev_threading_hook", None)
+    args = types.SimpleNamespace(
+        exc_type=ValueError,
+        exc_value=ValueError("worker died"),
+        exc_traceback=None,
+        thread=None,
+    )
+    flightrec._threading_hook(args)
+    dumps = [p for p in _dump_files(tmp_path) if "crash" in p.name]
+    assert dumps
+    assert "worker died" in json.loads(dumps[-1].read_text())["error"]
+
+
+def test_dump_never_raises(monkeypatch):
+    # point the dump at an unwritable location: it must log and return None
+    monkeypatch.setenv("OSIM_FLIGHT_DIR", "/proc/nonexistent/flight")
+    assert flightrec.dump("unit-test") is None
+
+
+def test_install_crash_hook_idempotent(monkeypatch):
+    import sys
+
+    monkeypatch.setattr(flightrec, "_hooks_installed", False)
+    monkeypatch.setattr(sys, "excepthook", sys.excepthook)
+    monkeypatch.setattr(threading, "excepthook", threading.excepthook)
+    flightrec.install_crash_hook()
+    first = sys.excepthook
+    flightrec.install_crash_hook()
+    assert sys.excepthook is first is flightrec._sys_hook
+    assert threading.excepthook is flightrec._threading_hook

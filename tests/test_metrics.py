@@ -354,3 +354,86 @@ def test_init_logging_idempotent_and_honors_loglevel(monkeypatch):
     assert tracing.log.handlers.count(handler) == 1
     assert handler.level == logging.DEBUG
     assert tracing.log.level == logging.DEBUG
+
+
+# ---------------------------------------------------------------------------
+# /metrics exposition-format compliance + trace-event cap rotation
+# ---------------------------------------------------------------------------
+
+def test_metrics_exposition_format_compliance():
+    """Prometheus text format 0.0.4: exact Content-Type (with charset),
+    EOF-safe trailing newline, every line a comment or a parseable sample."""
+    from open_simulator_tpu.server.server import make_server
+
+    httpd = make_server(0)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ) as resp:
+            assert (
+                resp.headers["Content-Type"]
+                == "text/plain; version=0.0.4; charset=utf-8"
+            )
+            text = resp.read().decode()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    # scrapers treat a missing final newline as a truncated exposition
+    assert text.endswith("\n") and not text.endswith("\n\n")
+    assert_valid_prometheus_text(text)
+    # every sample family is preceded by its HELP/TYPE comments
+    seen_type = set()
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            seen_type.add(line.split()[2])
+        elif line and not line.startswith("#"):
+            fam = re.match(r"[a-zA-Z_:][a-zA-Z0-9_:]*", line).group(0)
+            base = re.sub(r"_(bucket|count|sum)$", "", fam)
+            assert fam in seen_type or base in seen_type, line
+
+
+def test_render_always_ends_with_single_newline():
+    reg = MetricsRegistry()
+    assert reg.render().endswith("\n")  # even with zero families
+    reg.counter("fmt_probe_total", "h").inc()
+    out = reg.render()
+    assert out.endswith("\n") and not out.endswith("\n\n")
+
+
+def test_trace_file_event_cap_rotates_oldest(monkeypatch, tmp_path, caplog):
+    path = tmp_path / "trace.json"
+    monkeypatch.setenv("OSIM_TRACE_FILE", str(path))
+    monkeypatch.setenv("OSIM_TRACE_MAX_EVENTS", "5")
+    tracing.reset_trace_events()
+    try:
+        with caplog.at_level(logging.WARNING, logger=tracing.log.name):
+            for i in range(9):
+                with tracing.span(f"rotate-{i}"):
+                    pass
+        payload = json.loads(path.read_text())
+    finally:
+        tracing.reset_trace_events()
+    names = [e["name"] for e in payload["traceEvents"]]
+    # oldest-first rotation at the cap: only the newest 5 roots survive
+    assert names == [f"rotate-{i}" for i in range(4, 9)]
+    warnings = [
+        r for r in caplog.records if "event cap 5 reached" in r.getMessage()
+    ]
+    assert len(warnings) == 1  # one-time warning, not once per export
+
+
+def test_trace_event_cap_bad_value_falls_back(monkeypatch, tmp_path):
+    path = tmp_path / "trace.json"
+    monkeypatch.setenv("OSIM_TRACE_FILE", str(path))
+    monkeypatch.setenv("OSIM_TRACE_MAX_EVENTS", "not-a-number")
+    tracing.reset_trace_events()
+    try:
+        with tracing.span("cap-fallback"):
+            pass
+        payload = json.loads(path.read_text())
+    finally:
+        tracing.reset_trace_events()
+    assert [e["name"] for e in payload["traceEvents"]] == ["cap-fallback"]

@@ -1,0 +1,375 @@
+"""End-to-end trace propagation (utils/tracing.py TraceContext plumbing).
+
+One request = ONE connected trace, across every thread hop the serving
+path makes: handler -> admission queue -> scheduler-loop pack -> device
+call, simulate -> extender-wave pool threads -> outbound extender HTTP
+(W3C traceparent), and POST /v1/jobs -> job thread. Packed lanes that
+share one execution are related by span *links*, not fake parent edges.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from open_simulator_tpu.core.objects import Node
+from open_simulator_tpu.engine.simulator import (
+    AppResource,
+    ClusterResource,
+    simulate,
+)
+from open_simulator_tpu.models.profiles import ExtenderConfig
+from open_simulator_tpu.server import server as server_mod
+from open_simulator_tpu.server.admission import AdmissionQueue
+from open_simulator_tpu.utils import httppool, tracing
+from open_simulator_tpu.utils.tracing import TraceContext
+
+
+@pytest.fixture(autouse=True)
+def _fresh_pools():
+    httppool.reset_pools()
+    yield
+    httppool.reset_pools()
+
+
+def _recent(name):
+    return [r for r in tracing.recent_timings() if r["name"] == name]
+
+
+def _wait_for(pred, timeout_s=10.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# TraceContext / traceparent primitives
+# ---------------------------------------------------------------------------
+
+
+def test_traceparent_round_trip():
+    with tracing.span("origin") as s:
+        ctx = tracing.current_context()
+        header = tracing.current_traceparent()
+    assert ctx == TraceContext(s.trace_id, s.span_id)
+    assert header == f"00-{s.trace_id}-{s.span_id}-01"
+    back = TraceContext.from_traceparent(header)
+    assert back == ctx
+
+
+@pytest.mark.parametrize(
+    "header",
+    [
+        None,
+        "",
+        "garbage",
+        "00-zz-zz-01",
+        "00-" + "0" * 32 + "-" + "ab12ab12ab12ab12" + "-01",  # zero trace
+        "00-" + "ab" * 16 + "-" + "0" * 16 + "-01",           # zero span
+        "ff-" + "ab" * 16 + "-" + "ab12ab12ab12ab12" + "-01",  # bad version
+    ],
+)
+def test_traceparent_invalid_headers_return_none(header):
+    assert TraceContext.from_traceparent(header) is None
+
+
+def test_outside_any_trace_no_context_is_minted():
+    assert tracing.current_context() is None
+    assert tracing.current_trace_id() is None
+    assert tracing.current_traceparent() is None
+
+
+def test_activate_makes_thread_root_a_child_by_id():
+    with tracing.span("submitter") as parent:
+        ctx = tracing.current_context()
+    seen = {}
+
+    def worker():
+        with tracing.activate(ctx):
+            with tracing.span("far-side") as s:
+                seen["trace_id"] = s.trace_id
+                seen["parent_id"] = s.parent_id
+        # activation is scoped: after the with-block the thread is clean
+        seen["after"] = tracing.current_context()
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join(10.0)
+    assert seen["trace_id"] == parent.trace_id
+    assert seen["parent_id"] == parent.span_id
+    assert seen["after"] is None
+
+
+# ---------------------------------------------------------------------------
+# admission queue -> scheduler loop: the pack span
+# ---------------------------------------------------------------------------
+
+
+def test_pack_span_parents_first_lane_and_links_the_rest():
+    q = AdmissionQueue(
+        lambda bodies: [{"ok": 1} for _ in bodies],
+        depth=8, coalesce_ms=0.0, default_deadline_ms=0.0,
+    )
+    with tracing.span("req-a") as a:
+        ta = q.submit({"a": 1}, key="ka")
+    with tracing.span("req-b") as b:
+        tb = q.submit({"a": 2}, key="kb")
+    assert ta.trace_ctx == a.context()
+    assert tb.trace_ctx == b.context()
+    q.run_pending()
+    pack = _recent("loop-pack")[-1]
+    # parented (by ID) on the FIRST lane's trace...
+    assert pack["trace_id"] == a.trace_id
+    assert pack["parent_id"] == a.span_id
+    # ...and linked to every other lane (one span cannot have two parents)
+    assert {"trace_id": b.trace_id, "span_id": b.span_id} in pack["links"]
+    # both tickets point back at the pack that executed them
+    assert ta.pack_ctx == tb.pack_ctx
+    assert ta.pack_ctx.trace_id == a.trace_id
+    assert ta.pack_ctx.span_id == pack["span_id"]
+
+
+def test_pack_span_connected_across_the_loop_thread():
+    """The real worker thread: the pack span still joins the submitting
+    request's trace across the queue hop."""
+    q = AdmissionQueue(
+        lambda bodies: [{"ok": 1} for _ in bodies],
+        depth=8, pack_window_ms=0.0,
+    ).start()
+    try:
+        with tracing.span("request") as root:
+            t = q.submit({"a": 1}, key="k")
+        q.wait(t)
+        assert t.code == 200
+        assert _wait_for(
+            lambda: any(
+                p["trace_id"] == root.trace_id for p in _recent("loop-pack")
+            )
+        ), "loop-pack span never joined the request's trace"
+        pack = [
+            p for p in _recent("loop-pack")
+            if p["trace_id"] == root.trace_id
+        ][-1]
+        assert pack["parent_id"] == root.span_id
+    finally:
+        q.shutdown()
+        q.join(10.0)
+
+
+def test_untraced_submit_still_packs_with_fresh_trace():
+    q = AdmissionQueue(
+        lambda bodies: [{"ok": 1} for _ in bodies],
+        depth=8, coalesce_ms=0.0, default_deadline_ms=0.0,
+    )
+    t = q.submit({"a": 1}, key="k")
+    assert t.trace_ctx is None
+    q.run_pending()
+    assert t.code == 200
+    pack = _recent("loop-pack")[-1]
+    assert pack["trace_id"]
+    assert "parent_id" not in pack
+
+
+# ---------------------------------------------------------------------------
+# extender wave: pool threads + outbound traceparent
+# ---------------------------------------------------------------------------
+
+
+def _nodes(n, cpu="16"):
+    return [
+        Node.from_dict(
+            {
+                "metadata": {
+                    "name": f"n{i}",
+                    "labels": {"kubernetes.io/hostname": f"n{i}"},
+                },
+                "status": {
+                    "allocatable": {"cpu": cpu, "memory": "32Gi", "pods": "110"}
+                },
+            }
+        )
+        for i in range(n)
+    ]
+
+
+def _sts(replicas=1, cpu="1", name="w"):
+    return {
+        "kind": "StatefulSet",
+        "metadata": {"name": name, "namespace": "x"},
+        "spec": {
+            "replicas": replicas,
+            "template": {
+                "metadata": {"labels": {"app": name}},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "c",
+                            "image": "img",
+                            "resources": {"requests": {"cpu": cpu}},
+                        }
+                    ]
+                },
+            },
+        },
+    }
+
+
+def _ext(url, **kw):
+    return ExtenderConfig(
+        url_prefix=url, filter_verb="filter", prioritize_verb="prioritize",
+        **kw,
+    )
+
+
+def test_extender_wave_chains_stay_in_the_simulate_trace(
+    stub_factory, monkeypatch
+):
+    """Chains run on osim-extender pool threads; their spans must still be
+    children (by ID) of the dispatching simulate trace, and every outbound
+    extender request must carry that trace's traceparent header."""
+    stub = stub_factory({})
+    monkeypatch.setenv("OSIM_EXTENDER_WAVE", "4")
+    with tracing.span("wave-request") as root:
+        simulate(
+            ClusterResource(nodes=_nodes(4)),
+            [AppResource(name="a", objects=[_sts(replicas=4)])],
+            extenders=[_ext(stub.url)],
+        )
+    chains = [
+        r for r in _recent("extender-chain")
+        if r["trace_id"] == root.trace_id
+    ]
+    assert chains, "no extender-chain spans joined the simulate trace"
+    # every chain root's parent id resolves inside the root's own tree —
+    # one connected trace, no orphans
+    tree_ids = set()
+
+    def collect(d):
+        tree_ids.add(d["span_id"])
+        for c in d.get("children", ()):
+            collect(c)
+
+    for r in tracing.recent_timings():
+        if r.get("trace_id") == root.trace_id:
+            collect(r)
+    for ch in chains:
+        assert ch["parent_id"] in tree_ids
+        # the HTTP round trips nest under the chain on the pool thread
+        assert any(
+            c["name"] == "extender-http" for c in ch.get("children", ())
+        )
+    # outbound HTTP carried the trace on the wire
+    assert stub.request_headers, "stub saw no requests"
+    for hdr in stub.request_headers:
+        ctx = TraceContext.from_traceparent(hdr.get("traceparent"))
+        assert ctx is not None, "extender request missing traceparent"
+        assert ctx.trace_id == root.trace_id
+
+
+def test_serial_extender_sends_traceparent_on_both_transports(
+    stub_factory, monkeypatch
+):
+    for keepalive in ("1", "0"):
+        monkeypatch.setenv("OSIM_EXTENDER_KEEPALIVE", keepalive)
+        monkeypatch.setenv("OSIM_EXTENDER_WAVE", "0")
+        httppool.reset_pools()
+        stub = stub_factory({})
+        with tracing.span("serial-request") as root:
+            simulate(
+                ClusterResource(nodes=_nodes(2)),
+                [AppResource(name="a", objects=[_sts(replicas=1)])],
+                extenders=[_ext(stub.url)],
+            )
+        assert stub.request_headers, f"no requests (keepalive={keepalive})"
+        for hdr in stub.request_headers:
+            ctx = TraceContext.from_traceparent(hdr.get("traceparent"))
+            assert ctx is not None
+            assert ctx.trace_id == root.trace_id
+
+
+def test_untraced_extender_call_sends_no_traceparent(stub_factory):
+    """A roundtrip issued OUTSIDE any trace (simulate always opens one, so
+    this drives the extender directly) must not mint a traceparent — a
+    header nobody can correlate is noise. The extender-http client span the
+    roundtrip opens internally must not count as 'in a trace'."""
+    from open_simulator_tpu.engine.extenders import HTTPExtender
+
+    stub = stub_factory({})
+    ext = HTTPExtender(_ext(stub.url))
+    assert tracing.current_context() is None
+    ext._roundtrip(f"{stub.url}/filter", "filter", b"{}", 5.0)
+    assert stub.request_headers
+    assert all("traceparent" not in h for h in stub.request_headers)
+    # the same call inside a trace DOES carry the header
+    with tracing.span("outer") as root:
+        ext._roundtrip(f"{stub.url}/filter", "filter", b"{}", 5.0)
+    ctx = TraceContext.from_traceparent(
+        stub.request_headers[-1]["traceparent"]
+    )
+    assert ctx is not None and ctx.trace_id == root.trace_id
+
+
+# ---------------------------------------------------------------------------
+# HTTP server: incoming traceparent + X-Osim-Trace-Id echo
+# ---------------------------------------------------------------------------
+
+
+def _post(port, body, headers=None, timeout=10.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api/deploy-apps",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read() or b"{}")
+
+
+def test_server_continues_incoming_trace_and_echoes_trace_id(monkeypatch):
+    monkeypatch.setattr(
+        server_mod, "_execute_bodies",
+        lambda bodies: [{"ok": True} for _ in bodies],
+    )
+    srv = server_mod.make_server(0, queue_depth=4, coalesce_ms=0.0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        port = srv.server_address[1]
+        upstream_trace = "ab" * 16
+        header = f"00-{upstream_trace}-1234123412341234-01"
+        code, headers, _ = _post(
+            port, {"apps": []}, headers={"traceparent": header}
+        )
+        assert code == 200
+        # the response names the trace it belongs to — the caller's
+        assert headers["X-Osim-Trace-Id"] == upstream_trace
+        # the handler's root span continued the incoming trace by ID (the
+        # span closes just after the response bytes go out — poll briefly)
+        assert _wait_for(
+            lambda: any(
+                r["trace_id"] == upstream_trace
+                for r in _recent("http-request")
+            )
+        ), "handler root span never joined the incoming trace"
+        roots = [
+            r for r in _recent("http-request")
+            if r["trace_id"] == upstream_trace
+        ]
+        assert roots[-1]["parent_id"] == "1234123412341234"
+        # the pack that executed it is in the same trace and linked back
+        assert roots[-1]["links"], "handler root never linked its pack"
+        # without a header: a fresh trace id is still echoed
+        code, headers2, _ = _post(port, {"apps": [], "n": 2})
+        assert code == 200
+        fresh = headers2["X-Osim-Trace-Id"]
+        assert len(fresh) == 32 and fresh != upstream_trace
+    finally:
+        srv.shutdown()
+        srv.server_close()
