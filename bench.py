@@ -1667,6 +1667,35 @@ def _config_plan_scaled(n_pods, n_nodes):
     return out
 
 
+def config_prove_smoke(n_universes=512):
+    """The `simon prove` engine-vs-oracle checker on a strided sample of
+    the small-scope corpus: tracks the exhaustive checker's device
+    throughput and pins `universes_checked` into the bench JSON. The CI
+    prove job runs the full 151,875-universe corpus against the banked
+    contract; this is the bench-side heartbeat with the same engine path
+    (stamped-gather packing onto the scenario axis, one device call at
+    this sample size)."""
+    from open_simulator_tpu.analysis.semantics import run_prove
+
+    out = {"n_universes": n_universes}
+    t0 = time.time()
+    report = run_prove(smoke=n_universes, chunk=n_universes)
+    wall = time.time() - t0
+    out["wall_s"] = round(wall, 2)
+    out["universes_checked"] = report.universes_checked
+    out["device_calls"] = report.device_calls
+    out["divergences"] = report.divergence_total
+    out["digest"] = report.digest
+    out["value"] = round(report.universes_checked / wall, 1)
+    out["unit"] = "universes/s"
+    if report.divergence_total:
+        out["error"] = (
+            f"{report.divergence_total} oracle divergence(s); minimized "
+            f"counterexample: {report.minimized}"
+        )
+    return out
+
+
 def config_plan_200k_20k():
     """CPU-scaled million-node segment: 200k pods / 20k nodes (CI publishes
     this one; plan_1m_100k is the full-scale variant)."""
@@ -1694,6 +1723,7 @@ CONFIGS = {
     "serving_concurrent": config_serving_concurrent,
     "serving_saturation": config_serving_saturation,
     "resident_delta_10k": config_resident_delta_10k,
+    "prove_smoke": config_prove_smoke,
     "plan_200k_20k": config_plan_200k_20k,
     "plan_1m_100k": config_plan_1m_100k,
 }

@@ -25,7 +25,10 @@ extracted from the compiled artifact:
   meshes — lanes are independent by design, any cross-device op there is
   a sharding bug. ``SCENARIO_ONLY`` entries (global-id node indexing)
   are audited at node_devices == 1 only; node-sharded combos are skipped
-  visibly, never silently passed.
+  visibly, never silently passed. ``FIXED_SHAPE`` entries (the
+  small-scope prover engine, whose captured shapes are themselves the
+  contract) are lowered once, unsharded, at the canonical point — the
+  ladder does not apply to them.
 * **budget diff** — measurements compared against the checked-in
   per-(entry, rung, mesh) book (``budgets/preflight.json``); regressions
   fail CI without running a single program. ``--write-budgets`` is the
@@ -76,6 +79,19 @@ LANE_PARALLEL = frozenset({"ops.fast:schedule_scenarios"})
 #: skips node-sharded meshes *visibly* (``programs_skipped`` in the
 #: report) — a capability boundary, not a suppression.
 SCENARIO_ONLY = frozenset({"ops.fast:light_scan"})
+
+#: Entries whose captured shapes ARE the contract. The small-scope prover
+#: (`simon prove`, analysis/semantics.py) packs fixed bounded-scope
+#: universes onto the scenario axis, so EVERY leaf of
+#: ``schedule_universes`` — NodeStatic fields included — carries a leading
+#: stacked axis the per-field node-axis tables know nothing about;
+#: rescaling "the node dim" there rewrites the scenario axis on some
+#: leaves and misses others, producing a vmap axis-size mismatch. These
+#: entries are therefore lowered exactly once, at the captured shapes on
+#: a single device with no resharding, and every other (rung, mesh) combo
+#: is skipped *visibly* (``programs_skipped``) — a shape contract, not a
+#: suppression.
+FIXED_SHAPE = frozenset({"ops.fast:schedule_universes"})
 
 DEFAULT_RUNGS: Tuple[int, ...] = (64, 128)
 DEFAULT_MESHES: Tuple[str, ...] = ("1", "2x1", "2x2")
@@ -214,18 +230,31 @@ def abstract_args(
     mesh: Any,
     tables: Optional[Tuple[Dict[str, Optional[int]], Dict[str, Optional[int]]]] = None,
     pod_bucket: Optional[int] = None,
+    resize: bool = True,
 ) -> Tuple[tuple, dict]:
     """Captured concrete args -> ShapeDtypeStruct avals at ``rung``.
 
     Array leaves become avals (node dims rescaled, NamedSharding attached
     when ``mesh`` is a 2-D product mesh); non-array leaves (None, Python
     scalars — i.e. static args) pass through concrete. ``pod_bucket``
-    additionally rescales PodRow's leading axis (the 1M-pod verdict)."""
+    additionally rescales PodRow's leading axis (the 1M-pod verdict).
+    ``resize=False`` (FIXED_SHAPE entries) keeps every leaf at its
+    captured shape, unsharded — the ladder does not apply to them."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from ..ops.kernels import Carry, NodeStatic, PodRow
     from ..parallel import mesh as pmesh
+
+    if not resize:
+        def fixed(leaf):
+            if not hasattr(leaf, "dtype") or not hasattr(leaf, "shape"):
+                return leaf
+            return jax.ShapeDtypeStruct(tuple(leaf.shape), leaf.dtype)
+
+        args = tuple(jax.tree.map(fixed, a) for a in cap.args)
+        kwargs = {k: jax.tree.map(fixed, v) for k, v in cap.kwargs.items()}
+        return args, kwargs
 
     if tables is None:
         tables = _axis_tables()
@@ -381,6 +410,7 @@ def audit_program(
     mesh_tag: str,
     tables: Optional[tuple] = None,
     pod_bucket: Optional[int] = None,
+    resize: bool = True,
 ) -> ProgramAudit:
     """Lower-and-compile one entry at (rung, mesh) abstractly and extract
     memory stats + collective census. Never executes the program."""
@@ -389,9 +419,10 @@ def audit_program(
     pa = ProgramAudit(entry=cap.name, rung=int(rung), mesh=mesh_tag)
     t0 = time.perf_counter()
     try:
-        mesh = _build_mesh(mesh_tag)
+        mesh = _build_mesh(mesh_tag) if resize else None
         args, kwargs = abstract_args(
-            cap, rung, mesh, tables=tables, pod_bucket=pod_bucket
+            cap, rung, mesh, tables=tables, pod_bucket=pod_bucket,
+            resize=resize,
         )
         traced = cap.fn.trace(*args, **kwargs)
         compiled = traced.lower().compile()
@@ -595,7 +626,8 @@ class PreflightReport:
     budgets_path: str = ""
     seconds: float = 0.0
     #: (entry, rung, mesh) combos not compiled because the entry is
-    #: SCENARIO_ONLY and the mesh shards the node axis
+    #: SCENARIO_ONLY and the mesh shards the node axis, or the entry is
+    #: FIXED_SHAPE and the combo is off the canonical point
     programs_skipped: List[str] = dataclasses.field(default_factory=list)
 
     @property
@@ -684,8 +716,9 @@ class PreflightReport:
             )
         if self.programs_skipped:
             lines.append(
-                f"  skipped {len(self.programs_skipped)} scenario-only "
-                f"combo(s) on node-sharded meshes: "
+                f"  skipped {len(self.programs_skipped)} combo(s) outside "
+                f"entry capability (scenario-only on node-sharded meshes; "
+                f"fixed-shape off the canonical point): "
                 f"{', '.join(self.programs_skipped)}"
             )
         for v in self.violations:
@@ -746,6 +779,20 @@ def run_preflight(
     programs: List[ProgramAudit] = []
     programs_skipped: List[str] = []
     for cap in caps:
+        if cap.name in FIXED_SHAPE:
+            # the captured shapes are the contract: one compile, at the
+            # canonical point, unsharded; the rest of the matrix is
+            # skipped visibly (see FIXED_SHAPE)
+            programs.append(
+                audit_program(cap, N_CANON, "1", tables=tables,
+                              resize=False)
+            )
+            programs_skipped.extend(
+                program_key(cap.name, rung, tag)
+                for rung in rungs for tag in mesh_tags
+                if (rung, tag) != (N_CANON, "1")
+            )
+            continue
         for rung in rungs:
             for tag in mesh_tags:
                 _s, n_dev = parse_mesh(tag)

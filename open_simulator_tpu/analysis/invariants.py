@@ -6,10 +6,37 @@ canonical bucketed shapes the jaxpr auditor traces:
 
   * masks stay {0,1}-valued (bool dtype all the way to the entry outputs),
   * every score plugin lands in [0,100] (kube's checkPluginScores contract),
-  * no float output of any registered jit entry can be NaN, and
+  * no float output of any registered jit entry can be NaN,
   * the deliberate ``-inf * 0.0 → NaN`` sentinel pattern (fast.py's score
     lanes carry -inf on infeasible nodes) can never reach a selection point
-    — argmax/argmin/reduce_max/reduce_min/sort operands are proven NaN-free.
+    — argmax/argmin/reduce_max/reduce_min/sort operands are proven NaN-free,
+    and
+  * commit-carry resource counters (free CPU/mem, GPU memory, local-storage
+    VG/device capacity) stay non-negative through every commit scan.
+
+The last proof cannot come from the interval domain alone: a scan that
+subtracts a request from ``free`` each step widens ``free.lo`` to -inf at
+the fixpoint, because intervals cannot express the *relational* fact that
+the decrement only fires where the feasibility filter held. Instead the
+scan evaluator runs a structural **guarded-decrement matcher** over each
+scan body: a float carry slot whose update is
+``sub(carry_in, mul(convert(bool_guard), amount))`` is non-negative by
+induction when the guard's backward slice contains a feasibility
+comparison against that same carry slot (``req <= free + eps``). When the
+compared quantity is syntactically the decrement amount the slot is
+*proved* (``guard ⇒ amount ≤ slot + ε``, so ``slot ≥ -ε`` inductively);
+when the slice ties the guard to the slot but not to the amount (the GPU
+take path routes through an einsum the matcher does not chase) the slot is
+reported *guarded* — the residual amount bound is exactly what the
+exhaustive small-scope check (``simon prove``) discharges by running every
+bounded universe through the real engine. Recognition is idiom-structural,
+not a general theorem prover: an unguarded decrement of a float carry slot
+is a finding (``commit-carry-nonneg``) unless the scan's final carry is
+dropped — build_trajectory's virtual replay decrements unconditionally by
+design (onehot ≡ 1) and its recorded rows are gated by the feasibility
+masks stacked alongside them, so a dropped carry is classified ``virtual``
+rather than flagged. Anything else the matcher cannot classify is reported
+honestly as ``unrecognized`` rather than silently trusted.
 
 Abstract domain — per-array, element-uniform::
 
@@ -407,6 +434,31 @@ class InvariantFinding:
         return dataclasses.asdict(self)
 
 
+#: verdict ladder for one float carry slot of one scan, strongest first.
+CARRY_PROVED = "proved"              # guard ⇒ amount ≤ slot + ε
+CARRY_GUARDED = "guarded"            # bool guard tied to slot, amount not
+CARRY_NON_DECREASING = "non-decreasing"
+CARRY_UNCHANGED = "unchanged"
+CARRY_UNRECOGNIZED = "unrecognized"  # update shape outside the idiom set
+CARRY_VIRTUAL = "virtual"            # unguarded, but the final carry is
+                                     # dropped: a replay carry, not state
+CARRY_UNGUARDED = "unguarded"        # decrement with no bool guard: finding
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class CommitCarryReport:
+    """Non-negativity verdict for one float carry slot of one scan."""
+
+    path: str    # eqn path of the scan, e.g. "eqn0/scan"
+    slot: int
+    shape: str
+    verdict: str
+    detail: str
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
 class _Scope:
     """Per-jaxpr def-use environment. `alias` links this jaxpr's invars back
     to the caller's atoms (pjit inlining), so dataflow facts like "this
@@ -417,6 +469,116 @@ class _Scope:
     def __init__(self) -> None:
         self.def_of: Dict = {}
         self.alias: Dict = {}
+
+
+# ---------------------------------------------------------------------------
+# Guarded-decrement matcher helpers (commit-carry non-negativity)
+# ---------------------------------------------------------------------------
+
+#: primitives that forward their first operand's values unchanged — the
+#: matcher looks straight through them when resolving atom identity.
+_SHAPE_PRIMS = frozenset(
+    {"broadcast_in_dim", "reshape", "squeeze", "copy", "transpose",
+     "stop_gradient"}
+)
+
+#: feasibility comparisons; eq/ne deliberately excluded (an equality on a
+#: resource counter does not bound a decrement).
+_ORDER_COMPARISONS = frozenset({"gt", "ge", "lt", "le"})
+
+
+def _chase(defs: Dict, atom, literal_t):
+    """Resolve an atom through value-preserving shape primitives."""
+    while not isinstance(atom, literal_t):
+        q = defs.get(atom)
+        if q is None or q.primitive.name not in _SHAPE_PRIMS:
+            return atom
+        atom = q.invars[0]
+    return atom
+
+
+def _chase_eps(defs: Dict, atom, literal_t):
+    """Like _chase, but also through add/sub with a literal operand — the
+    commit filters compare against ``free + _EPS``, and the slop term must
+    not hide the carry slot from the matcher."""
+    while True:
+        if isinstance(atom, literal_t):
+            return atom
+        q = defs.get(atom)
+        if q is None:
+            return atom
+        name = q.primitive.name
+        if name in _SHAPE_PRIMS:
+            atom = q.invars[0]
+            continue
+        if name in ("add", "sub"):
+            a, b = (_chase(defs, x, literal_t) for x in q.invars)
+            if isinstance(b, literal_t):
+                atom = q.invars[0]
+                continue
+            if name == "add" and isinstance(a, literal_t):
+                atom = q.invars[1]
+                continue
+        return atom
+
+
+def _mul_factors(defs: Dict, atom, literal_t) -> List:
+    """Flatten a (possibly nested) product into its factor atoms, each
+    resolved through shape primitives."""
+    atom = _chase(defs, atom, literal_t)
+    q = defs.get(atom) if not isinstance(atom, literal_t) else None
+    if q is not None and q.primitive.name == "mul":
+        return (_mul_factors(defs, q.invars[0], literal_t)
+                + _mul_factors(defs, q.invars[1], literal_t))
+    return [atom]
+
+
+def _guard_origin(defs: Dict, factor, literal_t):
+    """If `factor` is a {0,1}-valued guard (a bool converted to the carry
+    dtype), return the underlying bool var; else None."""
+    if isinstance(factor, literal_t):
+        return None
+    q = defs.get(factor)
+    if (
+        q is not None
+        and q.primitive.name == "convert_element_type"
+        and np.dtype(q.invars[0].aval.dtype) == np.bool_
+    ):
+        g = _chase(defs, q.invars[0], literal_t)
+        return None if isinstance(g, literal_t) else g
+    return None
+
+
+def _comparisons_in_slice(defs: Dict, roots: Sequence, literal_t) -> List:
+    """All order-comparison eqns in the backward slice of `roots` (the
+    transitive defs of the guard inside one scan body)."""
+    seen_vars = set()
+    seen_eqns: Dict[int, object] = {}
+    stack = list(roots)
+    while stack:
+        v = stack.pop()
+        if v in seen_vars:
+            continue
+        seen_vars.add(v)
+        q = defs.get(v)
+        if q is None:
+            continue
+        if id(q) not in seen_eqns:
+            seen_eqns[id(q)] = q
+            for a in q.invars:
+                if not isinstance(a, literal_t) and a in defs:
+                    stack.append(a)
+    return [q for q in seen_eqns.values()
+            if q.primitive.name in _ORDER_COMPARISONS]
+
+
+def _aval_of(env: Dict, atom, literal_t) -> AVal:
+    if isinstance(atom, literal_t):
+        return from_concrete(atom.val)
+    got = env.get(atom)
+    if got is not None:
+        return got
+    return top(kind_of(atom.aval.dtype))
 
 
 # ---------------------------------------------------------------------------
@@ -431,6 +593,7 @@ class Interpreter:
         self.entry = entry
         self._findings: Dict[Tuple, InvariantFinding] = {}
         self._record = True
+        self.carry_proofs: List[CommitCarryReport] = []
 
     # -- findings -----------------------------------------------------------
 
@@ -450,13 +613,16 @@ class Interpreter:
     # -- jaxpr walking ------------------------------------------------------
 
     def run_closed(self, closed, in_avals: Sequence[AVal], path: str = "",
-                   alias: Optional[Dict] = None) -> List[AVal]:
+                   alias: Optional[Dict] = None,
+                   env_out: Optional[Dict] = None) -> List[AVal]:
         consts = [from_concrete(c) for c in closed.consts]
-        return self.run_jaxpr(closed.jaxpr, consts, in_avals, path, alias)
+        return self.run_jaxpr(closed.jaxpr, consts, in_avals, path, alias,
+                              env_out)
 
     def run_jaxpr(self, jaxpr, const_avals: Sequence[AVal],
                   in_avals: Sequence[AVal], path: str = "",
-                  alias: Optional[Dict] = None) -> List[AVal]:
+                  alias: Optional[Dict] = None,
+                  env_out: Optional[Dict] = None) -> List[AVal]:
         import jax
 
         literal_t = jax.core.Literal
@@ -485,6 +651,8 @@ class Interpreter:
                     env[v] = out
                     scope.def_of[v] = eqn
 
+        if env_out is not None:
+            env_out.update(env)
         return [read(v) for v in jaxpr.outvars]
 
     # -- eqn dispatch -------------------------------------------------------
@@ -632,9 +800,139 @@ class Interpreter:
         finally:
             self._record = prev_record
 
-        outs = self.run_closed(body, consts + carry + xs, path=f"{path}/scan/")
+        env_map: Dict = {}
+        outs = self.run_closed(body, consts + carry + xs,
+                               path=f"{path}/scan/", env_out=env_map)
+        if self._record:
+            self._check_commit_carry(eqn, env_map, f"{path}/scan")
         final_carry = [join(c, o) for c, o in zip(outs[:n_carry], carry)]
         return final_carry + outs[n_carry:]
+
+    # -- commit-carry non-negativity (guarded-decrement matcher) ------------
+
+    def _check_commit_carry(self, eqn, env: Dict, path: str) -> None:
+        """Classify every float carry slot of one scan body. See module
+        docstring: structural recognition of the commit idiom, with the
+        amount bound on *guarded* slots discharged by ``simon prove``."""
+        import jax
+
+        body = eqn.params["jaxpr"].jaxpr
+        n_const = eqn.params["num_consts"]
+        n_carry = eqn.params["num_carry"]
+        defs: Dict = {}
+        for q in body.eqns:
+            for v in q.outvars:
+                defs[v] = q
+        carry_vars = list(body.invars[n_const:n_const + n_carry])
+
+        dropvar_t = getattr(jax.core, "DropVar", ())
+        for slot, (cv, ov) in enumerate(zip(carry_vars,
+                                            body.outvars[:n_carry])):
+            if kind_of(cv.aval.dtype) != "f":
+                continue
+            verdict, detail = self._classify_carry_slot(
+                defs, env, cv, ov, jax.core.Literal
+            )
+            if verdict == CARRY_UNGUARDED and isinstance(
+                eqn.outvars[slot], dropvar_t
+            ):
+                # the final carry never escapes the scan: this is a
+                # virtual-commit replay (build_trajectory's onehot ≡ 1
+                # evolution), not committed cluster state. Negative values
+                # are reachable by design and gated by the feasibility
+                # masks recorded alongside them.
+                verdict = CARRY_VIRTUAL
+                detail = ("unconditional decrement, but the final carry is "
+                          "dropped — a virtual replay carry whose rows are "
+                          "gated by recorded feasibility masks downstream")
+            self.carry_proofs.append(CommitCarryReport(
+                path, slot, cv.aval.str_short(), verdict, detail
+            ))
+            if verdict == CARRY_UNGUARDED:
+                self.finding(
+                    "commit-carry-nonneg", "scan", f"{path}/carry{slot}",
+                    f"carry slot {slot} ({cv.aval.str_short()}): {detail}",
+                )
+
+    def _classify_carry_slot(self, defs, env, cv, ov, literal_t
+                             ) -> Tuple[str, str]:
+        out_atom = _chase(defs, ov, literal_t)
+        if out_atom is cv:
+            return CARRY_UNCHANGED, "carry slot is threaded through unchanged"
+        q = defs.get(out_atom)
+        if q is None:
+            return (CARRY_UNRECOGNIZED,
+                    "carry output rebinds a different input; not the commit "
+                    "idiom")
+
+        if q.primitive.name == "add":
+            sides = [_chase(defs, a, literal_t) for a in q.invars]
+            if cv not in sides:
+                return (CARRY_UNRECOGNIZED,
+                        f"update is add() but neither operand is the carry "
+                        f"slot")
+            inc = q.invars[1 - sides.index(cv)]
+            av = _aval_of(env, inc, literal_t)
+            if av.lo >= 0 and not av.neg_inf and not av.nan:
+                return (CARRY_NON_DECREASING,
+                        f"update adds a provably non-negative increment "
+                        f"({av.describe()})")
+            return (CARRY_UNRECOGNIZED,
+                    f"update adds an increment the domain cannot bound "
+                    f"below 0 ({av.describe()})")
+
+        if q.primitive.name != "sub":
+            return (CARRY_UNRECOGNIZED,
+                    f"update primitive '{q.primitive.name}' is outside the "
+                    f"guarded-decrement idiom")
+        if _chase(defs, q.invars[0], literal_t) is not cv:
+            return (CARRY_UNRECOGNIZED,
+                    "sub() minuend is not the carry slot itself")
+
+        dec = q.invars[1]
+        factors = _mul_factors(defs, dec, literal_t)
+        guards, amounts = [], []
+        for f in factors:
+            g = _guard_origin(defs, f, literal_t)
+            (guards if g is not None else amounts).append(
+                g if g is not None else f
+            )
+        if not guards:
+            av = _aval_of(env, dec, literal_t)
+            if av.hi <= 0 and not av.pos_inf and not av.nan:
+                return (CARRY_NON_DECREASING,
+                        f"unconditional sub of a non-positive amount "
+                        f"({av.describe()})")
+            return (CARRY_UNGUARDED,
+                    "decrement has no {0,1} bool-derived guard factor; the "
+                    "slot can go negative whenever the amount exceeds it")
+
+        # the guard's backward slice: does a feasibility comparison tie the
+        # guard to this carry slot (and, ideally, to the decrement amount)?
+        tied_to_slot = False
+        tied_to_amount = False
+        for comp in _comparisons_in_slice(defs, guards, literal_t):
+            sides = [_chase_eps(defs, a, literal_t) for a in comp.invars]
+            for i in (0, 1):
+                if sides[i] is cv:
+                    tied_to_slot = True
+                    other = sides[1 - i]
+                    if any(other is a for a in amounts):
+                        tied_to_amount = True
+        if tied_to_slot and tied_to_amount:
+            return (CARRY_PROVED,
+                    "guard ⇒ decrement amount ≤ slot + ε (feasibility "
+                    "comparison on this slot vs the amount is in the "
+                    "guard's slice): slot ≥ -ε by induction")
+        if tied_to_slot:
+            return (CARRY_GUARDED,
+                    "bool guard's slice compares this slot against a bound, "
+                    "but the decrement amount is not syntactically the "
+                    "compared quantity; residual discharged by simon prove")
+        return (CARRY_GUARDED,
+                "decrement is {0,1}-guarded but no comparison on this slot "
+                "was found in the guard's slice; non-negativity rests on "
+                "the small-scope exhaustive check (simon prove)")
 
 
 # ---------------------------------------------------------------------------
@@ -850,10 +1148,19 @@ class EntryInvariantReport:
     float_outputs: int
     outputs: List[str]
     findings: List[InvariantFinding]
+    commit_carry: List[CommitCarryReport] = dataclasses.field(
+        default_factory=list
+    )
 
     @property
     def ok(self) -> bool:
         return not self.findings
+
+    def carry_verdict_counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for p in self.commit_carry:
+            out[p.verdict] = out.get(p.verdict, 0) + 1
+        return out
 
     def to_dict(self) -> dict:
         return {
@@ -862,6 +1169,7 @@ class EntryInvariantReport:
             "bool_outputs": self.bool_outputs,
             "float_outputs": self.float_outputs,
             "outputs": self.outputs,
+            "commit_carry": [p.to_dict() for p in sorted(self.commit_carry)],
             "findings": [f.to_dict() for f in self.findings],
         }
 
@@ -902,7 +1210,8 @@ def check_traceable(entry: str, fn, args, kwargs=None) -> EntryInvariantReport:
                     f"float output {i} may be NaN ({out.describe()})",
                 )
     return EntryInvariantReport(
-        entry, bool_outputs, float_outputs, rendered, interp.findings
+        entry, bool_outputs, float_outputs, rendered, interp.findings,
+        list(interp.carry_proofs),
     )
 
 
@@ -1027,6 +1336,19 @@ class InvariantAudit:
                 if e.ok
                 else f"  [{mark}] {e.entry}"
             )
+            if e.commit_carry:
+                counts = e.carry_verdict_counts()
+                summary = ", ".join(
+                    f"{counts[v]} {v}" for v in (
+                        CARRY_PROVED, CARRY_GUARDED, CARRY_NON_DECREASING,
+                        CARRY_UNCHANGED, CARRY_VIRTUAL, CARRY_UNRECOGNIZED,
+                        CARRY_UNGUARDED,
+                    ) if v in counts
+                )
+                lines.append(
+                    f"        commit-carry: {len(e.commit_carry)} float "
+                    f"slot(s) — {summary}"
+                )
             for f in e.findings:
                 lines.append(f"        {f.kind} @ {f.path}: {f.message}")
         for p in sorted(self.plugins, key=lambda p: p.plugin):

@@ -68,6 +68,7 @@ AUDIT_TARGETS: Dict[str, Tuple[str, ...]] = {
         "gather_takes",
         "exit_carry",
         "schedule_scenarios",
+        "schedule_universes",
     ),
     "open_simulator_tpu.ops.grouped": ("_group_jit",),
     "open_simulator_tpu.ops.kernels": (
@@ -90,6 +91,7 @@ REQUIRED_COVERAGE = frozenset(
         "ops.fast:gather_takes",
         "ops.fast:exit_carry",
         "ops.fast:schedule_scenarios",
+        "ops.fast:schedule_universes",
         "ops.grouped:_group_jit",
         "ops.kernels:schedule_batch",
         "ops.kernels:probe_step",
@@ -422,6 +424,19 @@ def _capture_calls() -> List[_Captured]:
         fast.schedule_scenarios_host(
             ns, state_mod.stack_carry(carry, s_pad), batch,
             weights_s, valid_s, 2,
+        )
+        # the exhaustive-checking universe engine (`schedule_universes`,
+        # `simon prove`): every NodeStatic/Carry/PodRow leaf stacked to the
+        # scenario bucket (scalars widened to [S]), the exact packing
+        # analysis/semantics.py ships via stamped gather
+        stack_leaf = lambda a: jnp.broadcast_to(  # noqa: E731
+            a[None], (s_pad,) + a.shape
+        )
+        fast.schedule_universes(
+            jax.tree.map(stack_leaf, ns),
+            state_mod.stack_carry(carry, s_pad),
+            jax.tree.map(stack_leaf, rows),
+            weights_s,
         )
         # the resident-state delta kernels (engine/resident.py): scatter two
         # rows into the canonical free plane at production shapes (bucketed

@@ -57,6 +57,11 @@ AUDIT_SUPPRESS_RE = re.compile(
 )
 
 RULE_RACE = "race"
+RULE_DEADLOCK = "deadlock"
+
+#: every rule this auditor can emit; an `audit-ok[...]` naming anything
+#: else is reported stale immediately (it can never match a finding)
+KNOWN_RULES = frozenset({RULE_RACE, RULE_DEADLOCK})
 
 _LOCK_FACTORIES = {
     "Lock", "RLock", "Semaphore", "BoundedSemaphore", "Condition", "Event",
@@ -87,13 +92,14 @@ class RaceFinding:
     thread_root: str  # why this function is audited
     message: str
     suppressed: bool = False
+    rule: str = RULE_RACE
 
     def sort_key(self):
         return (self.path, self.line, self.col, self.state)
 
     def to_dict(self) -> dict:
         d = {
-            "rule": RULE_RACE,
+            "rule": self.rule,
             "path": self.path,
             "line": self.line,
             "col": self.col,
@@ -110,8 +116,8 @@ class RaceFinding:
     def render(self) -> str:
         tag = " (suppressed)" if self.suppressed else ""
         return (
-            f"{self.path}:{self.line}:{self.col}: race: {self.message} "
-            f"[via {self.thread_root}]{tag}"
+            f"{self.path}:{self.line}:{self.col}: {self.rule}: "
+            f"{self.message} [via {self.thread_root}]{tag}"
         )
 
 
@@ -133,6 +139,8 @@ class RaceAuditReport:
     locks: List[str]
     thread_roots: List[str]
     audited_functions: int
+    #: rendered acquisition-order edges "outer -> inner" (deadlock pass)
+    lock_edges: List[str] = dataclasses.field(default_factory=list)
 
     @property
     def active(self) -> List[RaceFinding]:
@@ -152,6 +160,7 @@ class RaceAuditReport:
             ],
             "shared_objects": self.shared_objects,
             "locks": self.locks,
+            "lock_edges": self.lock_edges,
             "thread_roots": self.thread_roots,
             "audited_functions": self.audited_functions,
         }
@@ -168,8 +177,9 @@ class RaceAuditReport:
             f"races: {len(self.active)} finding(s), {n_sup} suppressed, "
             f"{len(self.unused_suppressions)} stale suppression(s) — "
             f"{len(self.shared_objects)} shared object(s), "
-            f"{len(self.locks)} lock(s), {len(self.thread_roots)} thread "
-            f"root(s), {self.audited_functions} audited function(s)"
+            f"{len(self.locks)} lock(s), {len(self.lock_edges)} lock-order "
+            f"edge(s), {len(self.thread_roots)} thread root(s), "
+            f"{self.audited_functions} audited function(s)"
         )
         return "\n".join(out)
 
@@ -183,6 +193,9 @@ class ModuleShared:
     containers: Set[str] = dataclasses.field(default_factory=set)
     scalars: Set[str] = dataclasses.field(default_factory=set)
     locks: Set[str] = dataclasses.field(default_factory=set)
+    #: lock/instance-lock name -> factory ("Lock", "RLock", ...); instance
+    #: locks (`self.x = threading.Lock()` in a method) key as "Class.x"
+    lock_kinds: Dict[str, str] = dataclasses.field(default_factory=dict)
 
 
 def _module_level_assigns(tree: ast.Module) -> Iterator[ast.Assign]:
@@ -237,6 +250,8 @@ def collect_shared(mod: ModuleInfo) -> ModuleShared:
             callee = _callee_name(v)
             if callee in _LOCK_FACTORIES:
                 out.locks.update(names)
+                for n in names:
+                    out.lock_kinds[n] = callee
             elif callee in _CONTAINER_FACTORIES:
                 out.containers.update(names)
         elif isinstance(v, ast.Constant):
@@ -269,6 +284,28 @@ def collect_shared(mod: ModuleInfo) -> ModuleShared:
                     ):
                         globally_written.add(sub.id)
     out.scalars = candidates_scalar & globally_written
+
+    # instance locks: `self.x = threading.Lock()` anywhere in a class body
+    # registers "Class.x" — `with self.x:` in that class's methods resolves
+    # to it (the AdmissionQueue/SchedulerLoop/session-pool pattern)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Assign) or not isinstance(
+                sub.value, ast.Call
+            ):
+                continue
+            callee = _callee_name(sub.value)
+            if callee not in _LOCK_FACTORIES:
+                continue
+            for t in sub.targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    out.lock_kinds[f"{node.name}.{t.attr}"] = callee
     return out
 
 
@@ -387,6 +424,34 @@ def _method_index(ctx: LintContext) -> Dict[str, List[Tuple[str, str]]]:
     return index
 
 
+def _call_targets(
+    ctx: LintContext, mod: ModuleInfo, cls: str, node: ast.Call,
+    method_index: Optional[Dict[str, List[Tuple[str, str]]]] = None,
+) -> Iterator[Tuple[str, str]]:
+    """Every (module, qualname) a single Call node may enter."""
+    resolved = ctx.resolve_call(mod, node.func)
+    if resolved is not None:
+        yield resolved
+    f = node.func
+    if not isinstance(f, ast.Attribute):
+        return
+    if isinstance(f.value, ast.Name) and f.value.id == "self":
+        if cls:
+            sibling = f"{cls}.{f.attr}"
+            if any(i.qualname == sibling for i in mod.functions.values()):
+                yield (mod.name, sibling)
+    elif (
+        method_index is not None
+        and isinstance(f.value, ast.Attribute)
+        and isinstance(f.value.value, ast.Name)
+        and f.value.value.id == "self"
+    ):
+        # self.<attr>.<method>() — cross-class hop, unique-name only
+        candidates = method_index.get(f.attr, [])
+        if len(candidates) == 1:
+            yield candidates[0]
+
+
 def _calls_from(
     ctx: LintContext, mod: ModuleInfo, info: FunctionInfo,
     method_index: Optional[Dict[str, List[Tuple[str, str]]]] = None,
@@ -395,27 +460,7 @@ def _calls_from(
     for node in ast.walk(info.node):
         if not isinstance(node, ast.Call):
             continue
-        resolved = ctx.resolve_call(mod, node.func)
-        if resolved is not None:
-            yield resolved
-        f = node.func
-        if not isinstance(f, ast.Attribute):
-            continue
-        if isinstance(f.value, ast.Name) and f.value.id == "self":
-            if cls:
-                sibling = f"{cls}.{f.attr}"
-                if any(i.qualname == sibling for i in mod.functions.values()):
-                    yield (mod.name, sibling)
-        elif (
-            method_index is not None
-            and isinstance(f.value, ast.Attribute)
-            and isinstance(f.value.value, ast.Name)
-            and f.value.value.id == "self"
-        ):
-            # self.<attr>.<method>() — cross-class hop, unique-name only
-            candidates = method_index.get(f.attr, [])
-            if len(candidates) == 1:
-                yield candidates[0]
+        yield from _call_targets(ctx, mod, cls, node, method_index)
 
 
 def audited_functions(
@@ -641,6 +686,310 @@ def _scan_function(
 
 
 # ---------------------------------------------------------------------------
+# lock-order deadlock pass
+# ---------------------------------------------------------------------------
+#
+# Two thread roots acquiring the same locks in opposite orders deadlock the
+# process; so does blocking forever (join()/Queue.get() with no timeout)
+# while holding a lock another thread needs. Both are order properties the
+# race pass above cannot see. This pass:
+#
+#   1. resolves every `with <lock>:` in the audited (thread-reachable)
+#      functions to a canonical lock identity — module-level locks
+#      ("mod:name", including `with othermod.lock:`) and instance locks
+#      ("mod:Class.attr" from `self.x = threading.Lock()`);
+#   2. computes each function's may-acquire set (direct + transitive
+#      callees, interprocedural fixpoint over the same call graph the race
+#      pass walks);
+#   3. builds the lock-acquisition graph: edge L1 -> L2 when L2 is acquired
+#      (directly or via a callee) while L1 is held;
+#   4. reports every cycle (Tarjan SCC; self-edges only for non-reentrant
+#      plain Locks) and every no-timeout blocking call made under a lock.
+#
+# An ``osim: audit-ok[deadlock]`` comment on the flagged line suppresses a
+# finding, with the same staleness cross-check as the race rule.
+
+@dataclasses.dataclass
+class _LockUse:
+    """One audited function's lock behavior."""
+    acquires: Dict[str, ast.AST] = dataclasses.field(default_factory=dict)
+    orders: List[Tuple[str, str, ast.AST]] = dataclasses.field(
+        default_factory=list
+    )
+    calls_holding: List[Tuple[Tuple[str, ...], Tuple[str, str], ast.AST]] = (
+        dataclasses.field(default_factory=list)
+    )
+    blocking: List[Tuple[Tuple[str, ...], str, ast.AST]] = dataclasses.field(
+        default_factory=list
+    )
+    calls: Set[Tuple[str, str]] = dataclasses.field(default_factory=set)
+
+
+def _resolve_lock(
+    expr: ast.expr, mod: ModuleInfo, cls: str, ctx: LintContext,
+    shared: Dict[str, ModuleShared],
+) -> Optional[str]:
+    """Canonical lock id for a `with` context expression, if it is one."""
+    my = shared[mod.name]
+    if isinstance(expr, ast.Name) and expr.id in my.locks:
+        return f"{mod.name}:{expr.id}"
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+        if expr.value.id == "self" and cls:
+            if f"{cls}.{expr.attr}" in my.lock_kinds:
+                return f"{mod.name}:{cls}.{expr.attr}"
+            return None
+        target = _imported_module(mod, expr.value.id, ctx)
+        if (
+            target is not None
+            and target in shared
+            and expr.attr in shared[target].locks
+        ):
+            return f"{target}:{expr.attr}"
+    return None
+
+
+def _blocking_verb(node: ast.Call) -> Optional[str]:
+    """'.join()' / '.get()' when the call can block forever.
+
+    Zero-positional-arg is the discriminator: `thread.join()` and
+    `queue.get()` block indefinitely, while `",".join(parts)` and
+    `d.get(key)` always carry a positional argument. A timeout= (or
+    block=False) keyword makes either bounded.
+    """
+    f = node.func
+    if not isinstance(f, ast.Attribute) or f.attr not in ("join", "get"):
+        return None
+    if node.args:
+        return None
+    kwargs = {kw.arg for kw in node.keywords}
+    if "timeout" in kwargs or "block" in kwargs:
+        return None
+    return f".{f.attr}()"
+
+
+def _lock_use(
+    ctx: LintContext, mod: ModuleInfo, info: FunctionInfo,
+    shared: Dict[str, ModuleShared],
+    method_index: Dict[str, List[Tuple[str, str]]],
+) -> _LockUse:
+    use = _LockUse()
+    cls = _class_of(info.qualname)
+    anno = _guarded_by_decorator(info)
+    base_held: Tuple[str, ...] = (
+        (f"{mod.name}:{anno}",) if anno else ()
+    )
+
+    def visit(node: ast.AST, held: Tuple[str, ...]):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and (
+            node is not info.node
+        ):
+            return  # nested defs are separate audit entries
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            taken: List[str] = []
+            for item in node.items:
+                visit(item.context_expr, held)
+                lock = _resolve_lock(
+                    item.context_expr, mod, cls, ctx, shared
+                )
+                if lock is not None:
+                    use.acquires.setdefault(lock, node)
+                    for h in held + tuple(taken):
+                        use.orders.append((h, lock, node))
+                    taken.append(lock)
+            new = held + tuple(t for t in taken if t not in held)
+            for child in node.body:
+                visit(child, new)
+            return
+        if isinstance(node, ast.Call):
+            if held:
+                verb = _blocking_verb(node)
+                if verb is not None:
+                    use.blocking.append((held, verb, node))
+            for tgt in _call_targets(ctx, mod, cls, node, method_index):
+                use.calls.add(tgt)
+                if held:
+                    use.calls_holding.append((held, tgt, node))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in info.node.body:  # type: ignore[attr-defined]
+        visit(stmt, base_held)
+    return use
+
+
+def _lock_kind(lock: str, shared: Dict[str, ModuleShared]) -> str:
+    mod_name, _, name = lock.partition(":")
+    s = shared.get(mod_name)
+    return s.lock_kinds.get(name, "") if s else ""
+
+
+def deadlock_pass(
+    ctx: LintContext,
+    shared: Dict[str, ModuleShared],
+    audited: Dict[Tuple[str, str], str],
+) -> Tuple[List[RaceFinding], List[str]]:
+    """-> (findings, rendered lock-graph edges)."""
+    method_index = _method_index(ctx)
+    uses: Dict[Tuple[str, str], _LockUse] = {}
+    infos: Dict[Tuple[str, str], Tuple[ModuleInfo, FunctionInfo]] = {}
+    for key in audited:
+        mod = ctx.modules.get(key[0])
+        if mod is None:
+            continue
+        info = next(
+            (i for i in mod.functions.values() if i.qualname == key[1]), None
+        )
+        if info is None:
+            continue
+        infos[key] = (mod, info)
+        uses[key] = _lock_use(ctx, mod, info, shared, method_index)
+
+    # interprocedural may-acquire fixpoint (call graph is small; iterate)
+    may_acquire: Dict[Tuple[str, str], Set[str]] = {
+        k: set(u.acquires) for k, u in uses.items()
+    }
+    changed = True
+    while changed:
+        changed = False
+        for k, u in uses.items():
+            for tgt in u.calls:
+                extra = may_acquire.get(tgt, set()) - may_acquire[k]
+                if extra:
+                    may_acquire[k].update(extra)
+                    changed = True
+
+    # acquisition edges: (outer, inner) -> (mod, site node, function key)
+    edges: Dict[Tuple[str, str], Tuple[ModuleInfo, ast.AST, Tuple[str, str]]]
+    edges = {}
+    for k, u in uses.items():
+        mod = infos[k][0]
+        for outer, inner, site in u.orders:
+            edges.setdefault((outer, inner), (mod, site, k))
+        for held, tgt, site in u.calls_holding:
+            for inner in may_acquire.get(tgt, ()):
+                for outer in held:
+                    edges.setdefault((outer, inner), (mod, site, k))
+
+    findings: List[RaceFinding] = []
+
+    def emit(key: Tuple[str, str], site: ast.AST, mod: ModuleInfo,
+             state: str, access: str, msg: str):
+        findings.append(
+            RaceFinding(
+                path=mod.path,
+                line=getattr(site, "lineno", 0),
+                col=getattr(site, "col_offset", 0),
+                state=state,
+                function=f"{key[0]}:{key[1]}",
+                access=access,
+                thread_root=audited.get(key, "?"),
+                message=msg,
+                rule=RULE_DEADLOCK,
+            )
+        )
+
+    # self-edges: re-acquiring a non-reentrant Lock you already hold
+    # deadlocks immediately; RLock/Semaphore/Condition re-entry does not
+    for (outer, inner), (mod, site, key) in sorted(
+        edges.items(), key=lambda e: (e[0], e[1][0].path)
+    ):
+        if outer == inner and _lock_kind(outer, shared) == "Lock":
+            emit(
+                key, site, mod, outer, "lock-order",
+                f"non-reentrant lock {outer} re-acquired while already "
+                f"held — self-deadlock",
+            )
+
+    # cycles across distinct locks: Tarjan SCC over the acquisition graph
+    adj: Dict[str, List[str]] = {}
+    for outer, inner in edges:
+        if outer != inner:
+            adj.setdefault(outer, []).append(inner)
+            adj.setdefault(inner, [])
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Dict[str, bool] = {}
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str):
+        # iterative Tarjan (the lock graph is tiny, but no recursion limits)
+        work = [(v, iter(adj.get(v, ())))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack[v] = True
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack[w] = True
+                    work.append((w, iter(adj.get(w, ()))))
+                    advanced = True
+                    break
+                if on_stack.get(w):
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack[w] = False
+                    comp.append(w)
+                    if w == node:
+                        break
+                sccs.append(comp)
+
+    for v in sorted(adj):
+        if v not in index:
+            strongconnect(v)
+
+    for comp in sccs:
+        if len(comp) < 2:
+            continue
+        comp_set = set(comp)
+        cycle_edges = sorted(
+            (o, i) for (o, i) in edges
+            if o in comp_set and i in comp_set and o != i
+        )
+        mod, site, key = edges[cycle_edges[0]]
+        order = " -> ".join(sorted(comp_set) + [sorted(comp_set)[0]])
+        emit(
+            key, site, mod, ",".join(sorted(comp_set)), "lock-order",
+            f"lock-order cycle {order}: threads acquiring these locks in "
+            f"different orders can deadlock; establish one global order "
+            f"(edges: "
+            + "; ".join(f"{o} then {i}" for o, i in cycle_edges)
+            + ")",
+        )
+
+    # blocking calls under a lock
+    for k, u in uses.items():
+        mod = infos[k][0]
+        for held, verb, site in u.blocking:
+            emit(
+                k, site, mod, ",".join(sorted(held)), "blocking",
+                f"unbounded blocking call `{verb}` while holding "
+                f"{', '.join(sorted(held))} — any thread needing the lock "
+                f"waits forever if the peer never finishes; pass a timeout "
+                f"or move the wait outside the lock",
+            )
+
+    rendered = sorted(f"{o} -> {i}" for (o, i) in edges if o != i)
+    return findings, rendered
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -674,6 +1023,9 @@ def run_races(
         if info is not None:
             _scan_function(ctx, mod, info, shared, reason, findings)
 
+    deadlock_findings, lock_edges = deadlock_pass(ctx, shared, audited)
+    findings.extend(deadlock_findings)
+
     # dedupe (a function reachable from several roots scans once per (line,
     # state) anyway; reachability map already collapses roots)
     uniq: Dict[Tuple, RaceFinding] = {}
@@ -681,7 +1033,8 @@ def run_races(
         uniq.setdefault((f.path, f.line, f.col, f.state, f.access), f)
     findings = sorted(uniq.values(), key=RaceFinding.sort_key)
 
-    # apply + cross-check audit-ok suppressions
+    # apply + cross-check audit-ok suppressions (per rule: an audit-ok for
+    # one rule never silences the other)
     used: Set[Tuple[str, int, str]] = set()
     sup_by_mod = {m.name: _audit_suppressions(m) for m in ctx.modules.values()}
     path_to_mod = {m.path: m.name for m in ctx.modules.values()}
@@ -690,17 +1043,15 @@ def run_races(
         if mod_name is None:
             continue
         sup = sup_by_mod[mod_name].get(f.line, set())
-        if RULE_RACE in sup:
+        if f.rule in sup:
             f.suppressed = True
-            used.add((f.path, f.line, RULE_RACE))
+            used.add((f.path, f.line, f.rule))
 
     unused: List[UnusedSuppression] = []
     for mod in ctx.modules.values():
         for line, rules in sorted(sup_by_mod[mod.name].items()):
             for r in sorted(rules):
-                if r != RULE_RACE:
-                    unused.append(UnusedSuppression(mod.path, line, r))
-                elif (mod.path, line, r) not in used:
+                if r not in KNOWN_RULES or (mod.path, line, r) not in used:
                     unused.append(UnusedSuppression(mod.path, line, r))
 
     shared_objects = sorted(
@@ -718,6 +1069,7 @@ def run_races(
         locks=locks,
         thread_roots=sorted(set(roots.values())),
         audited_functions=len(audited),
+        lock_edges=lock_edges,
     )
 
 

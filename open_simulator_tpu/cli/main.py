@@ -1,4 +1,5 @@
-"""simon CLI: apply / server / lint / audit / preflight / version / gen-doc.
+"""simon CLI: apply / server / lint / audit / preflight / prove / version /
+gen-doc.
 
 Parity: `/root/reference/cmd/` (cobra commands → argparse subcommands):
   apply   -f/--simon-config, --output-file, -i/--interactive, --use-greed,
@@ -753,6 +754,85 @@ def _run_preflight(args) -> int:
     return 0 if report.ok else 1
 
 
+def _add_prove(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser(
+        "prove",
+        help="exhaustive small-scope semantics check against the pure "
+        "oracle + commit-order contract verification",
+        description=(
+            "Small-scope semantics prover: enumerate EVERY scheduling "
+            "universe in a bounded family (4 node slots x 5 pod slots "
+            "drawn from a quantized catalog — 151,875 distinct universes), "
+            "run the real ops.fast:schedule_universes engine over all of "
+            "them in a handful of identically-shaped vmapped device calls, "
+            "and diff every placement, reason code, GPU assignment and "
+            "final carry against the independent pure-numpy oracle "
+            "(analysis/oracle.py). Full runs also verify the canonical "
+            "commit-order contract (budgets/commit_contract.json) that "
+            "the conflict-parallel wave commit must reproduce; any "
+            "divergence exits 1 with a minimized counterexample universe. "
+            "See docs/static-analysis.md."
+        ),
+    )
+    p.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output format (json is the machine-readable CI artifact)",
+    )
+    p.add_argument(
+        "--contract", default=None, metavar="PATH",
+        help="contract artifact to verify "
+        "(default: budgets/commit_contract.json)",
+    )
+    p.add_argument(
+        "--write-contract", action="store_true",
+        help="bank this run's placement digest as the canonical contract "
+        "instead of verifying — the only sanctioned way to admit a "
+        "commit-order change (refused over a diverging corpus)",
+    )
+    p.add_argument(
+        "--smoke", type=int, default=None, metavar="N",
+        help="check only N universes strided across the corpus (engine vs "
+        "oracle only; the digest is sample-dependent, so no contract "
+        "verdict)",
+    )
+    p.add_argument(
+        "--chunk", type=int, default=None, metavar="S",
+        help="universes per device call (default: 25608 — six calls, one "
+        "compile for the full corpus)",
+    )
+    p.add_argument(
+        "--mutate", choices=("tiebreak", "nocommit"), default=None,
+        help="seeded commit-rule fault injection: run a deliberately-wrong "
+        "engine variant; the checker must exit nonzero with a minimized "
+        "counterexample (proves the prover)",
+    )
+
+
+def _run_prove(args) -> int:
+    import json as _json
+
+    from ..analysis import semantics
+
+    report = semantics.run_prove(
+        contract_path=args.contract or semantics.CONTRACT_PATH,
+        write=args.write_contract,
+        smoke=args.smoke,
+        chunk=args.chunk or semantics.DEFAULT_CHUNK,
+        mutate=args.mutate,
+        progress=(
+            (lambda done, total: print(
+                f"prove: {done}/{total} universes", file=sys.stderr
+            ))
+            if args.format == "text" else None
+        ),
+    )
+    if args.format == "json":
+        print(_json.dumps(report.to_dict(), indent=2, sort_keys=True))
+    else:
+        print(report.render_text())
+    return 0 if report.ok else 1
+
+
 def _add_warmup(sub: argparse._SubParsersAction) -> None:
     p = sub.add_parser(
         "warmup",
@@ -956,6 +1036,7 @@ def main(argv=None) -> int:
     _add_lint(sub)
     _add_preflight(sub)
     _add_profile(sub)
+    _add_prove(sub)
     _add_runs(sub)
     _add_sweep(sub)
     _add_warmup(sub)
@@ -1020,7 +1101,7 @@ def main(argv=None) -> int:
             ).strip()
     if args.command in (
         "apply", "chaos", "server", "runs", "sweep", "warmup", "preflight",
-        "profile",
+        "profile", "prove",
     ):
         from ..utils.platform import enable_compilation_cache, ensure_platform
         from ..utils.tracing import init_logging
@@ -1067,6 +1148,8 @@ def main(argv=None) -> int:
         return _run_warmup(args)
     if args.command == "profile":
         return _run_profile(args)
+    if args.command == "prove":
+        return _run_prove(args)
     if args.command == "gen-doc":
         return _gen_doc(parser, args.output_dir)
     if args.command == "server":

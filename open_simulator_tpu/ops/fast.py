@@ -1833,6 +1833,44 @@ def schedule_scenarios(
     return jax.vmap(one)(valid_s, carry_s, weights_s)
 
 
+@sanitizable("ops.fast:schedule_universes", donate_argnums=(1,))
+@functools.partial(jax.jit, donate_argnums=(1,))
+def schedule_universes(
+    ns_s: NodeStatic,
+    carry_s: Carry,
+    pods_s: PodRow,
+    weights_s: jnp.ndarray,
+    filter_on=None,
+):
+    """Exhaustive-checking axis: vmap the naive commit scan over universes
+    where EVERYTHING varies per lane — node tensors, carry, pod sequence and
+    weights (every NodeStatic/Carry/PodRow leaf stacked on axis 0, scalars
+    widened to [S]).
+
+    schedule_scenarios varies only (valid, carry, weights) around one shared
+    cluster; `simon prove` (analysis/semantics.py) needs whole distinct
+    *universes* per lane — different node capacities, labels, taints, pod
+    requests, selectors — packed from a small catalog by stamped gather. The
+    body is the same naive scan that every fast path proves bit-identity to,
+    so lane u reproduces exactly what a serial schedule_batch over universe
+    u's table would commit.
+
+    Returns (carry_s, nodes i32[S,P], reasons i32[S,P,F], gpu_take i32[S,P,G],
+    vg_take f32[S,P,V], dev_take f32[S,P,DV]).
+    """
+
+    def one(ns, carry, pods, weights):
+        def step(c, pod):
+            return schedule_step(ns, weights, c, pod, filter_on)
+
+        final, (nodes, reasons, gpu_take, vg_take, dev_take) = jax.lax.scan(
+            step, carry, pods
+        )
+        return final, nodes, reasons, gpu_take, vg_take, dev_take
+
+    return jax.vmap(one)(ns_s, carry_s, pods_s, weights_s)
+
+
 def schedule_scenarios_host(
     ns: NodeStatic,
     carry_s: Carry,

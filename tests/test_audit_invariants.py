@@ -217,3 +217,91 @@ def test_audit_json_shape(audit):
     assert [e["entry"] for e in doc["entries"]] == sorted(
         e["entry"] for e in doc["entries"]
     )
+
+
+# ---------------------------------------------------------------------------
+# commit-carry non-negativity: the guarded-decrement matcher
+# ---------------------------------------------------------------------------
+
+def _scan_entry(step, n_nodes=4, n_res=2, n_pods=5):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    free = jnp.full((n_nodes, n_res), 8.0, jnp.float32)
+    reqs = jnp.ones((n_pods, n_res), jnp.float32)
+    return jax.jit(lambda f, r: lax.scan(step, f, r)), (free, reqs)
+
+
+def test_commit_carry_guarded_decrement_proved():
+    import jax.numpy as jnp
+
+    def step(free, req):
+        fits = jnp.all(req[None, :] <= free + 1e-6, axis=1)
+        score = jnp.where(fits, -jnp.sum(free, axis=1), -jnp.inf)
+        choice = jnp.argmax(score)
+        onehot = (jnp.arange(free.shape[0]) == choice) & jnp.any(fits)
+        return free - onehot[:, None].astype(free.dtype) * req[None, :], choice
+
+    fn, args = _scan_entry(step)
+    rep = check_traceable("fixture:guarded_commit", fn, args)
+    assert rep.ok, [f.to_dict() for f in rep.findings]
+    verdicts = {p.verdict for p in rep.commit_carry}
+    assert inv.CARRY_PROVED in verdicts, [p.to_dict() for p in rep.commit_carry]
+
+
+def test_commit_carry_unguarded_decrement_is_a_finding():
+    import jax.numpy as jnp
+
+    def step(free, req):
+        # the commit with its feasibility guard deleted: the exact bug the
+        # pass exists to catch
+        return free - req[None, :], jnp.sum(free)
+
+    fn, args = _scan_entry(step)
+    rep = check_traceable("fixture:unguarded_commit", fn, args)
+    assert not rep.ok
+    assert any(f.kind == "commit-carry-nonneg" for f in rep.findings)
+    assert any(p.verdict == inv.CARRY_UNGUARDED for p in rep.commit_carry)
+
+
+def test_commit_carry_dropped_carry_is_virtual_not_flagged():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def step(free, req):
+        return free - req[None, :], free  # record-then-decrement replay
+
+    def run(free, reqs):
+        _, rows = lax.scan(step, free, reqs)  # final carry discarded
+        return rows
+
+    free = jnp.full((4, 2), 8.0, jnp.float32)
+    reqs = jnp.ones((5, 2), jnp.float32)
+    rep = check_traceable("fixture:virtual_replay", jax.jit(run), (free, reqs))
+    assert rep.ok, [f.to_dict() for f in rep.findings]
+    assert any(p.verdict == inv.CARRY_VIRTUAL for p in rep.commit_carry)
+
+
+def test_real_commit_entries_prove_carry_nonneg(audit):
+    by_name = {e.entry: e for e in audit.entries}
+    for entry in (
+        "ops.kernels:schedule_batch",
+        "ops.fast:schedule_scenarios",
+        "ops.fast:schedule_universes",
+        "ops.kernels:commit_step",
+        "ops.kernels:commit_wave",
+    ):
+        e = by_name[entry]
+        counts = e.carry_verdict_counts()
+        # the free CPU/mem slot of every commit scan carries the full
+        # inductive proof; GPU/storage decrements are at least guarded
+        assert counts.get(inv.CARRY_PROVED, 0) >= 1, (entry, counts)
+        assert inv.CARRY_UNGUARDED not in counts, (entry, counts)
+        assert not any(
+            f.kind == "commit-carry-nonneg" for f in e.findings
+        ), entry
+    # the virtual-commit replay is classified, not flagged
+    traj = by_name["ops.fast:build_trajectory"]
+    assert traj.carry_verdict_counts().get(inv.CARRY_VIRTUAL, 0) >= 1

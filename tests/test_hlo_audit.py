@@ -312,6 +312,23 @@ def test_scenario_only_entry_skips_node_sharded_meshes(caps):
     assert report.ok, report.render_text()
 
 
+def test_fixed_shape_entry_is_never_rung_resized():
+    # the prover engine stacks EVERY leaf on the scenario axis at the
+    # small-scope pads; rung-rescaling such a capture corrupts the vmap
+    # axis. FIXED_SHAPE entries keep their captured shapes, unsharded.
+    assert "ops.fast:schedule_universes" in H.FIXED_SHAPE
+    cap = types.SimpleNamespace(
+        name="ops.fast:schedule_universes",
+        fn=None,
+        args=(np.ones((8, 64, 4), np.float32), np.ones((8, 4), np.int32)),
+        kwargs={"n_valid": 5},
+    )
+    args, kwargs = H.abstract_args(cap, rung=128, mesh=None, resize=False)
+    assert [a.shape for a in args] == [(8, 64, 4), (8, 4)]
+    assert all(a.sharding is None for a in args)
+    assert kwargs == {"n_valid": 5}
+
+
 def test_budget_write_and_diff_flow(caps, tmp_path):
     subset = _only(caps, "ops.kernels:probe_step")
     report = H.run_preflight(

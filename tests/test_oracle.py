@@ -1,0 +1,234 @@
+"""The `simon prove` reference oracle and small-scope checker.
+
+Three layers, per the prover's trust chain:
+
+* constants cross-check — oracle.py deliberately REDECLARES every shared
+  contract constant (filter indices, weight fold order, f32 slack) instead
+  of importing ops/kernels.py; these tests are the tripwire that catches a
+  drift on either side before the prover silently compares two different
+  contracts.
+* hand-pinned universes — the oracle's verdicts on feasibility edges,
+  score ties, priority presentation order, and unschedulable reason codes
+  are asserted as literal values written straight from the kube contract,
+  so the oracle cannot regress into merely agreeing with the engine.
+* engine agreement + seeded mutation (slow, compile-heavy) — the same
+  pinned universes run through the real vmapped engine via
+  `check_universes`, and a perturbed commit rule must produce divergences
+  and a minimized counterexample (the acceptance teeth of `simon prove`).
+"""
+
+import numpy as np
+import pytest
+
+from open_simulator_tpu.analysis import oracle
+from open_simulator_tpu.analysis.semantics import SmallScope, Universe
+
+
+@pytest.fixture(scope="module")
+def scope():
+    return SmallScope()
+
+
+def _oracle(scope, nodes, pods):
+    u = Universe(nodes, pods)
+    return oracle.schedule(scope.oracle_table(u), scope.oracle_batch(u))
+
+
+# ---------------------------------------------------------------------------
+# shared contract constants: redeclared in oracle.py, cross-checked here
+# ---------------------------------------------------------------------------
+
+def test_filter_indices_match_kernels():
+    from open_simulator_tpu.ops import kernels as k
+
+    names = (
+        "F_UNSCHEDULABLE", "F_NODE_NAME", "F_TAINT", "F_NODE_AFFINITY",
+        "F_NODE_PORTS", "F_RESOURCES", "F_SPREAD", "F_POD_AFFINITY",
+        "F_STORAGE", "F_GPU", "F_EXTRA", "NUM_FILTERS",
+    )
+    for name in names:
+        assert getattr(oracle, name) == getattr(k, name), name
+
+
+def test_weights_and_fold_order_match_kernels():
+    from open_simulator_tpu.ops import kernels as k
+
+    assert oracle.DEFAULT_WEIGHTS == k.DEFAULT_WEIGHTS
+    assert oracle.WEIGHT_ORDER == k.WEIGHT_ORDER
+
+
+def test_eps_and_encode_vocab_match():
+    from open_simulator_tpu.ops import encode, kernels as k
+
+    assert oracle.EPS == np.float32(k._EPS)
+    assert oracle.GPU_COUNT_IDX == encode.GPU_COUNT_IDX
+    assert (
+        oracle.OP_PAD, oracle.OP_IN, oracle.OP_NOT_IN, oracle.OP_EXISTS,
+        oracle.OP_NOT_EXISTS, oracle.OP_GT, oracle.OP_LT,
+    ) == (
+        encode.OP_PAD, encode.OP_IN, encode.OP_NOT_IN, encode.OP_EXISTS,
+        encode.OP_NOT_EXISTS, encode.OP_GT, encode.OP_LT,
+    )
+
+
+# ---------------------------------------------------------------------------
+# hand-pinned universes: literal verdicts from the kube contract
+# ---------------------------------------------------------------------------
+
+def test_feasibility_edge_exact_fit(scope):
+    # node B is 2 cpu / 4 Gi; pod p is 1 cpu / 2 Gi: exactly two fit (the
+    # f32 comparison slack must not admit a third), the rest report the
+    # resources filter as the first failure.
+    r = _oracle(scope, "B---", "ppppp")
+    assert r.nodes[:5].tolist() == [0, 0, -1, -1, -1]
+    for row in (2, 3, 4):
+        assert r.reasons[row, oracle.F_RESOURCES] == 1
+        assert r.reasons[row].sum() == 1
+
+
+def test_score_tie_breaks_to_lowest_node_index(scope):
+    # two identical A nodes: every plugin scores them equally for the first
+    # pod, and the contract's tie-break is argmax-lowest-index — node 0.
+    r = _oracle(scope, "AA--", "ppppp")
+    assert r.nodes[0] == 0
+    # subsequent pods alternate as least-allocated rebalances
+    assert r.nodes[:5].tolist() == [0, 1, 0, 1, 0]
+
+
+def test_priority_presentation_order(scope):
+    # q (prio 10) is presented before the slot-earlier p's (prio 0): it
+    # claims its 2 cpu first, so only two p's fit behind it. The scan
+    # engine models priority by presentation order, not eviction.
+    rows = scope.pod_rows(Universe("A---", "ppppq"))
+    r = _oracle(scope, "A---", "ppppq")
+    # q is catalog row 1: despite sitting in the last slot it is presented
+    # first (descending priority, stable slot index — the contract clause)
+    assert rows[0] == 1 and rows[1:5] == [0, 0, 0, 0]
+    assert r.nodes[:5].tolist() == [0, 0, 0, -1, -1]
+    assert r.reasons[3, oracle.F_RESOURCES] == 1
+    assert r.reasons[4, oracle.F_RESOURCES] == 1
+
+
+def test_unschedulable_reason_codes(scope):
+    # cordoned node -> unschedulable filter
+    r = _oracle(scope, "D---", "ppppp")
+    assert (r.nodes[:5] == -1).all()
+    assert (r.reasons[:5, oracle.F_UNSCHEDULABLE] == 1).all()
+    # tier=a nodeSelector vs tier=b node -> node-affinity filter
+    r = _oracle(scope, "B---", "qqqqq")
+    assert (r.nodes[:5] == -1).all()
+    assert (r.reasons[:5, oracle.F_NODE_AFFINITY] == 1).all()
+    # GPU-share pod vs GPU-less node -> gpu filter
+    r = _oracle(scope, "A---", "rrrrr")
+    assert (r.nodes[:5] == -1).all()
+    assert (r.reasons[:5, oracle.F_GPU] == 1).all()
+
+
+def test_gpu_share_commit_and_exhaustion(scope):
+    # C carries 2 devices x 8 Gi; r takes a 4 Gi share: four shares total,
+    # the fifth r fails the gpu filter with every share consumed.
+    r = _oracle(scope, "C---", "rrrrr")
+    assert r.nodes[:5].tolist() == [0, 0, 0, 0, -1]
+    assert r.gpu_take[:4].sum(axis=1).tolist() == [1, 1, 1, 1]
+    assert r.reasons[4, oracle.F_GPU] == 1
+    assert float(r.carry.gpu_free.sum()) == 0.0
+
+
+def test_pad_rows_are_inert(scope):
+    # P is padded to 8: pad rows place nowhere and report nothing
+    r = _oracle(scope, "B---", "ppppp")
+    assert (r.nodes[5:] == -1).all()
+    assert r.reasons[5:].sum() == 0
+
+
+# ---------------------------------------------------------------------------
+# CLI plumbing: exit codes and json shape, no device work
+# ---------------------------------------------------------------------------
+
+def _fake_report(diverging: bool):
+    from open_simulator_tpu.analysis.semantics import Divergence, ProveReport
+
+    rep = ProveReport(universes_checked=7, device_calls=1, digest="sha256:x")
+    if diverging:
+        rep.divergence_total = 1
+        rep.divergences = [Divergence("AA--/ppppp", "nodes", "1", "0")]
+        rep.minimized = "--AA/ppppp"
+    return rep
+
+
+def test_cli_prove_exit_codes(monkeypatch, capsys):
+    import json
+
+    from open_simulator_tpu.analysis import semantics
+    from open_simulator_tpu.cli import main as cli
+
+    monkeypatch.setattr(
+        semantics, "run_prove", lambda **kw: _fake_report(False)
+    )
+    assert cli.main(["prove", "--format=json", "--smoke", "7"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["ok"] is True and doc["universes_checked"] == 7
+
+    monkeypatch.setattr(
+        semantics, "run_prove", lambda **kw: _fake_report(True)
+    )
+    assert cli.main(["prove", "--format=json", "--smoke", "7"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["ok"] is False
+    assert doc["minimized_counterexample"] == "--AA/ppppp"
+
+
+# ---------------------------------------------------------------------------
+# engine agreement + seeded mutations (compile-heavy)
+# ---------------------------------------------------------------------------
+
+PINNED = [
+    Universe("B---", "ppppp"),
+    Universe("AA--", "ppppp"),
+    Universe("D---", "ppppp"),
+    Universe("B---", "qqqqq"),
+    Universe("A---", "rrrrr"),
+    Universe("A---", "ppppq"),
+    Universe("C---", "rrrrr"),
+]
+
+
+@pytest.mark.slow
+def test_pinned_universes_match_live_engine(scope):
+    from open_simulator_tpu.analysis.semantics import check_universes
+
+    report = check_universes(scope, PINNED)
+    assert report.ok, report.render_text()
+    assert report.universes_checked == len(PINNED)
+    assert report.device_calls == 1
+    assert report.digest.startswith("sha256:")
+
+
+@pytest.mark.slow
+def test_mutated_tiebreak_is_caught_and_minimized(scope):
+    from open_simulator_tpu.analysis.semantics import (
+        check_universes,
+        minimize,
+    )
+
+    # highest-index tie-break flips the AA tie; non-tied universes still
+    # agree, so the divergence is attributable to the seeded rule change
+    report = check_universes(scope, PINNED, mutate="tiebreak")
+    assert report.divergence_total > 0
+    bad = report.divergences[0].universe.split("/")
+    small = minimize(scope, Universe(*bad), "tiebreak")
+    # the minimized counterexample still diverges and is no larger
+    assert len(small.nodes.replace("-", "")) <= len(
+        bad[0].replace("-", "")
+    )
+
+
+@pytest.mark.slow
+def test_mutated_nocommit_is_caught(scope):
+    from open_simulator_tpu.analysis.semantics import check_universes
+
+    # dropping the carry thread makes every pod see the pristine cluster:
+    # the feasibility-edge universe must diverge on placements or carry
+    report = check_universes(scope, PINNED, mutate="nocommit")
+    assert report.divergence_total > 0
+    assert not report.ok
