@@ -1707,6 +1707,112 @@ def config_plan_1m_100k():
     return _config_plan_scaled(1_000_000, 100_000)
 
 
+def config_checkpoint_overhead(n_pods=10_000, n_nodes=100, chunk=1024):
+    """Config: the chunked-commit checkpoint tax (docs/durability.md). The
+    same 10k-pod commit scan dispatched once monolithically and once
+    chunked (OSIM_COMMIT_CHUNK) under a live PlanCheckpointer — every
+    chunk journaled, a carry+prefix snapshot every 4 chunks, all into a
+    throwaway run dir. Each mode runs twice and reports its warm wall
+    (the chunked program compiles separately on the first pass);
+    overhead_x is warm-vs-warm and must stay within 5%: checkpointing is
+    host-side bookkeeping between device dispatches, not extra device
+    work. The two final carries must also digest-match bit-for-bit — the
+    chunked driver's byte-identity contract, asserted at bench scale."""
+    import shutil
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from open_simulator_tpu.durable import RunJournal
+    from open_simulator_tpu.durable.checkpoint import (
+        PlanCheckpointer,
+        installed,
+    )
+    from open_simulator_tpu.ops import fast
+    from open_simulator_tpu.ops import state as state_mod
+    from open_simulator_tpu.ops.kernels import weights_array
+    from open_simulator_tpu.utils import metrics
+
+    ns, carry, batch = build_state(n_nodes, n_pods)
+    s_pad = fast.scenario_bucket(1)
+    w_s = jnp.asarray(np.stack([np.asarray(weights_array())] * s_pad))
+    valid_s = jnp.asarray(np.stack([np.asarray(ns.valid)] * s_pad))
+
+    def run_once():
+        import jax
+
+        carry_s = state_mod.stack_carry(carry, s_pad)
+        t0 = time.time()
+        out = fast.schedule_scenarios_host(
+            ns, carry_s, batch, w_s, valid_s, 1
+        )
+        jax.block_until_ready(out[0])
+        wall = time.time() - t0
+        return wall, fast.scenario_carry_digest(out[0])
+
+    def _put_env(key, val):
+        if val is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = val
+
+    def run_mode(chunked: bool):
+        prev = os.environ.get("OSIM_COMMIT_CHUNK")
+        prev_every = os.environ.get("OSIM_CKPT_EVERY")
+        run_dir = None
+        try:
+            if chunked:
+                os.environ["OSIM_COMMIT_CHUNK"] = str(chunk)
+                os.environ["OSIM_CKPT_EVERY"] = "4"
+                run_dir = tempfile.mkdtemp(prefix="osim-ckpt-bench-")
+                journal = RunJournal.open(run_dir)
+                try:
+                    with installed(PlanCheckpointer(journal)):
+                        cold, _ = run_once()
+                        warm, digest = run_once()
+                finally:
+                    journal.close()
+            else:
+                os.environ.pop("OSIM_COMMIT_CHUNK", None)
+                cold, _ = run_once()
+                warm, digest = run_once()
+        finally:
+            _put_env("OSIM_COMMIT_CHUNK", prev)
+            _put_env("OSIM_CKPT_EVERY", prev_every)
+            if run_dir:
+                shutil.rmtree(run_dir, ignore_errors=True)
+        return cold, warm, digest
+
+    m_cold, m_warm, m_digest = run_mode(chunked=False)
+    bytes0 = metrics.CHECKPOINT_BYTES.value()
+    chunks0 = metrics.PLAN_CHUNKS.value()
+    c_cold, c_warm, c_digest = run_mode(chunked=True)
+    overhead = (c_warm / m_warm) if m_warm > 0 else None
+    out = {
+        "wall_s": round(c_warm, 2),
+        "value": round(n_pods / c_warm, 1) if c_warm > 0 else None,
+        "monolithic_wall_s": round(m_warm, 2),
+        "chunked_wall_s": round(c_warm, 2),
+        "monolithic_cold_wall_s": round(m_cold, 2),
+        "chunked_cold_wall_s": round(c_cold, 2),
+        "overhead_x": round(overhead, 3) if overhead else None,
+        "chunk": chunk,
+        "chunks_dispatched": int(metrics.PLAN_CHUNKS.value() - chunks0),
+        "checkpoint_bytes": int(metrics.CHECKPOINT_BYTES.value() - bytes0),
+        "digest": f"{c_digest:08x}",
+    }
+    if c_digest != m_digest:
+        out["error"] = (
+            f"chunked digest {c_digest:08x} != monolithic {m_digest:08x}; "
+            "the chunked driver must be byte-identical"
+        )
+    elif overhead is not None and overhead > 1.05:
+        out["error"] = (
+            f"checkpoint overhead {overhead:.3f}x exceeds the 1.05x budget"
+        )
+    return out
+
+
 CONFIGS = {
     "stock": config_stock,
     "fit_1k_100n": config_fit,
@@ -1726,6 +1832,7 @@ CONFIGS = {
     "prove_smoke": config_prove_smoke,
     "plan_200k_20k": config_plan_200k_20k,
     "plan_1m_100k": config_plan_1m_100k,
+    "checkpoint_overhead": config_checkpoint_overhead,
 }
 
 # Excluded from `--configs all`: run them by name (CI runs plan_200k_20k

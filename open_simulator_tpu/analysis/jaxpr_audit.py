@@ -68,6 +68,7 @@ AUDIT_TARGETS: Dict[str, Tuple[str, ...]] = {
         "gather_takes",
         "exit_carry",
         "schedule_scenarios",
+        "schedule_scenarios_chunked",
         "schedule_universes",
     ),
     "open_simulator_tpu.ops.grouped": ("_group_jit",),
@@ -91,6 +92,7 @@ REQUIRED_COVERAGE = frozenset(
         "ops.fast:gather_takes",
         "ops.fast:exit_carry",
         "ops.fast:schedule_scenarios",
+        "ops.fast:schedule_scenarios_chunked",
         "ops.fast:schedule_universes",
         "ops.grouped:_group_jit",
         "ops.kernels:schedule_batch",
@@ -424,6 +426,14 @@ def _capture_calls() -> List[_Captured]:
         fast.schedule_scenarios_host(
             ns, state_mod.stack_carry(carry, s_pad), batch,
             weights_s, valid_s, 2,
+        )
+        # the chunked commit driver (`schedule_scenarios_chunked`,
+        # OSIM_COMMIT_CHUNK > 0): one count-gated chunk at the same lane
+        # shapes — a partial chunk (count < C) so the gate path is traced
+        rows_c = jax.tree.map(lambda a: a[:4], rows)
+        fast.schedule_scenarios_chunked(
+            ns, state_mod.stack_carry(carry, s_pad), rows_c,
+            weights_s, valid_s, jnp.int32(3),
         )
         # the exhaustive-checking universe engine (`schedule_universes`,
         # `simon prove`): every NodeStatic/Carry/PodRow leaf stacked to the

@@ -469,6 +469,19 @@ def _add_chaos(sub: argparse._SubParsersAction) -> None:
         "--default-scheduler-config", default="",
         help="KubeSchedulerConfiguration YAML merged with simon's plugin set",
     )
+    p.add_argument(
+        "--capacity", action="store_true",
+        help="mid-plan-kill scenario: run a chunked capacity sweep under "
+        "the plan's device faults (chunk_kill SIGKILLs a subprocess "
+        "mid-chunk, device_lost is recovered in place), resume it, and "
+        "prove the resumed placements byte-match a clean reference "
+        "(docs/durability.md)",
+    )
+    p.add_argument(
+        "--run-dir", default="",
+        help="--capacity: journal the faulted sweep here (default: a "
+        "temporary directory, removed afterwards)",
+    )
 
 
 def _run_chaos(args) -> int:
@@ -495,6 +508,9 @@ def _run_chaos(args) -> int:
             file=sys.stderr,
         )
         return 1
+
+    if args.capacity:
+        return _run_chaos_capacity(args, plan)
 
     # A clean slate makes the report a pure function of (config, plan seed):
     # same seed in -> byte-identical report out.
@@ -598,6 +614,240 @@ def _run_chaos(args) -> int:
         lines.append("outcome: clean — no degradation observed")
     print("\n".join(lines))
     return 0
+
+
+def _fault_plan_doc(plan) -> dict:
+    """Serialize a FaultPlan back to its YAML schema (only non-default
+    fields), so chaos can hand the exact plan to a subprocess via
+    OSIM_FAULT_PLAN."""
+    rules = []
+    for r in plan.rules:
+        doc: dict = {"target": r.target, "kind": r.kind}
+        if r.op:
+            doc["op"] = r.op
+        if r.times is not None:
+            doc["times"] = r.times
+        if r.after:
+            doc["after"] = r.after
+        if r.probability != 1.0:
+            doc["probability"] = r.probability
+        if r.latency_s:
+            doc["latency_s"] = r.latency_s
+        if r.status != 503:
+            doc["status"] = r.status
+        if r.body:
+            doc["body"] = r.body
+        rules.append(doc)
+    return {"seed": plan.seed, "rules": rules}
+
+
+def _run_chaos_capacity(args, plan) -> int:
+    """`simon chaos --capacity`: the mid-plan-kill scenario.
+
+    Three legs: (1) a clean in-process chunked capacity sweep banks the
+    reference placement digest; (2) the same sweep runs journaled in a
+    subprocess under the fault plan — `chunk_kill` SIGKILLs it mid-chunk
+    (the child cannot report anything; its journal and snapshots are the
+    evidence), `device_lost` is recovered inside the child from its last
+    good carry; (3) a killed run is resumed in-process (faults OFF —
+    resume must work on a healthy host) and the final placement digest is
+    compared byte-for-byte with the reference. Degraded-not-failed means:
+    faults fired, the plan still landed, and the digests match (exit 0)."""
+    import contextlib as _ctx
+    import io as _io
+    import json as _json
+    import os as _os
+    import shutil as _shutil
+    import subprocess as _sp
+    import tempfile as _tf
+
+    import yaml as _yaml
+
+    from ..api.config import SimonConfig
+    from ..engine.apply import (
+        ApplyError,
+        build_apps,
+        build_cluster,
+        load_new_node,
+        placement_digest,
+    )
+    from ..engine.capacity import plan_capacity
+    from ..durable import replay
+    from ..resilience.policy import reset_breakers
+    from ..utils import metrics
+
+    chunk = _os.environ.get("OSIM_COMMIT_CHUNK", "").strip() or "8"
+    every = _os.environ.get("OSIM_CKPT_EVERY", "").strip() or "2"
+    metrics.REGISTRY.reset()
+    reset_breakers()
+
+    run_dir = args.run_dir or _tf.mkdtemp(prefix="simon-chaos-capacity-")
+    cleanup = not args.run_dir
+    saved = {
+        k: _os.environ.get(k)
+        for k in ("OSIM_COMMIT_CHUNK", "OSIM_CKPT_EVERY")
+    }
+    _os.environ["OSIM_COMMIT_CHUNK"] = chunk
+    _os.environ["OSIM_CKPT_EVERY"] = every
+    try:
+        try:
+            cfg = SimonConfig.load(args.simon_config)
+            cluster = build_cluster(cfg)
+            apps = build_apps(cfg)
+            new_node = load_new_node(cfg)
+        except (ApplyError, ValueError, OSError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        if new_node is None:
+            print(
+                "error: chaos --capacity needs a newNode candidate in the "
+                "config", file=sys.stderr,
+            )
+            return 1
+        ref = plan_capacity(cluster, apps, new_node, sweep_mode="batched")
+        if ref is None:
+            print(
+                "error: reference capacity sweep found no fitting plan",
+                file=sys.stderr,
+            )
+            return 1
+        ref_digest = placement_digest(ref.result)
+
+        plan_path = _os.path.join(run_dir, "fault-plan.yaml")
+        with open(plan_path, "w") as fh:
+            _yaml.safe_dump(_fault_plan_doc(plan), fh, sort_keys=True)
+        env = dict(_os.environ)
+        env["OSIM_FAULT_PLAN"] = plan_path
+        child = _sp.run(
+            [sys.executable, "-m", "open_simulator_tpu.cli.main", "sweep",
+             "--capacity", "-f", args.simon_config, "--run-dir", run_dir],
+            env=env, stdout=_sp.DEVNULL, stderr=_sp.DEVNULL,
+        )
+        killed = child.returncode in (137, -9)
+        if killed:
+            import argparse as _argparse
+
+            with _ctx.redirect_stdout(_io.StringIO()):
+                rc = _run_sweep(_argparse.Namespace(
+                    simon_config=args.simon_config, capacity=True,
+                    node_counts="", use_greed=False, format="text",
+                    run_dir=run_dir, resume=True,
+                ))
+            if rc != 0:
+                print(
+                    f"error: resume of the killed sweep failed (rc {rc})",
+                    file=sys.stderr,
+                )
+                return 1
+        elif child.returncode != 0:
+            print(
+                f"error: faulted sweep exited rc {child.returncode} "
+                "(expected 0, or SIGKILL from a chunk_kill rule)",
+                file=sys.stderr,
+            )
+            return 1
+
+        try:
+            with open(_os.path.join(run_dir, "outcome.json")) as fh:
+                outcome = _json.load(fh)
+        except (OSError, ValueError):
+            outcome = {}
+        got_digest = str(outcome.get("placement_digest", ""))
+
+        events = replay(run_dir)
+        n_chunk_records = sum(
+            1 for e in events if e.get("event") == "plan_chunk"
+        )
+
+        def total(counter) -> int:
+            snap = counter.snapshot()
+            return int(sum(s["value"] for s in snap["samples"]))
+
+        skipped = total(metrics.RESUME_CHUNKS_SKIPPED)
+        art_kinds: dict = {}
+        last_note = None
+        for name in sorted(_os.listdir(run_dir)):
+            if not name.startswith("flightrec-"):
+                continue
+            try:
+                with open(_os.path.join(run_dir, name)) as fh:
+                    doc = _json.load(fh)
+            except (OSError, ValueError):
+                continue
+            reason = str(doc.get("reason", "?"))
+            art_kinds[reason] = art_kinds.get(reason, 0) + 1
+            for ev in doc.get("events", []):
+                if ev.get("kind") in ("plan-restore", "device-lost"):
+                    last_note = ev
+
+        lines = ["simon chaos report", "=================="]
+        lines.append(f"fault plan: seed={plan.seed}, {len(plan.rules)} rule(s)")
+        for i, r in enumerate(plan.rules, 1):
+            lines.append(
+                f"  rule {i}: target={r.target} op={r.op or '*'} "
+                f"kind={r.kind}"
+            )
+        lines.append(
+            "scenario: chunked capacity sweep "
+            f"(OSIM_COMMIT_CHUNK={chunk}, snapshot every {every} chunk(s))"
+        )
+        lines.append("degraded:")
+        lines.append(
+            "  faulted run: "
+            + ("killed mid-plan (SIGKILL), resumed from checkpoint"
+               if killed else
+               "completed — device faults recovered in place")
+        )
+        lines.append(f"  plan_chunk records journaled: {n_chunk_records}")
+        lines.append(f"  chunks restored from snapshot on resume: {skipped}")
+        lines.append(
+            f"  device_lost recoveries: {art_kinds.get('device-lost', 0)}"
+        )
+        if last_note is not None:
+            where = last_note.get("restored_to", last_note.get("chunk"))
+            lines.append(
+                f"  last good chunk: {where} "
+                f"(carry digest {last_note.get('digest')})"
+            )
+        lines.append(
+            "  flight artifacts: "
+            + (", ".join(f"{k}:{v}" for k, v in sorted(art_kinds.items()))
+               or "none")
+        )
+        lines.append("failed:")
+        match = bool(got_digest) and got_digest == ref_digest
+        lines.append(
+            "  placement digest vs clean reference: "
+            + ("match" if match else "MISMATCH")
+        )
+        if not n_chunk_records:
+            lines.append(
+                "outcome: failed — the chunked commit driver never engaged "
+                "(workload too small for OSIM_COMMIT_CHUNK?)"
+            )
+            print("\n".join(lines))
+            return 1
+        if not match:
+            lines.append(
+                "outcome: failed — resumed placements diverge from the "
+                "clean reference"
+            )
+            print("\n".join(lines))
+            return 1
+        lines.append(
+            "outcome: degraded — plan survived the device fault(s); "
+            "placements byte-identical to the clean run"
+        )
+        print("\n".join(lines))
+        return 0
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                _os.environ.pop(k, None)
+            else:
+                _os.environ[k] = v
+        if cleanup:
+            _shutil.rmtree(run_dir, ignore_errors=True)
 
 
 def _add_audit(sub: argparse._SubParsersAction) -> None:

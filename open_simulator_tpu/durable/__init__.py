@@ -7,6 +7,12 @@ acquisition and blocking device calls and degrades TPU→CPU with honest
 top-level provenance instead of hanging. See docs/durability.md.
 """
 
+from .checkpoint import (  # noqa: F401
+    CheckpointError,
+    PlanCheckpointer,
+    active_checkpointer,
+    checkpoint_every,
+)
 from .journal import (  # noqa: F401
     JournalError,
     RunJournal,

@@ -304,6 +304,44 @@ def plan_capacity(
     resume: bool = False,
     sweep_mode: str = "auto",
 ) -> Optional[CapacityPlan]:
+    """Public entry: _plan_capacity_impl with mid-plan checkpointing armed.
+
+    A journaled call installs a durable.checkpoint.PlanCheckpointer for its
+    duration, so when the chunked commit driver is on (OSIM_COMMIT_CHUNK)
+    every batched-sweep device call journals `plan_chunk` records and
+    periodically snapshots its carry — a SIGKILL *inside* one sweep then
+    resumes mid-scan instead of re-running the whole call (`resume=True`
+    replays the journal tail; see docs/durability.md). Unjournaled calls
+    pay nothing. See _plan_capacity_impl for the full search contract."""
+    if journal is None:
+        return _plan_capacity_impl(
+            cluster, apps, new_node, max_new_nodes, weights, use_greed,
+            mesh, profiles, extenders, journal, resume, sweep_mode,
+        )
+    from ..durable.checkpoint import PlanCheckpointer, installed
+
+    cp = PlanCheckpointer(journal, resume=resume)
+    with installed(cp):
+        return _plan_capacity_impl(
+            cluster, apps, new_node, max_new_nodes, weights, use_greed,
+            mesh, profiles, extenders, journal, resume, sweep_mode,
+        )
+
+
+def _plan_capacity_impl(
+    cluster: ClusterResource,
+    apps: Sequence[AppResource],
+    new_node: Node,
+    max_new_nodes: int = 1 << 14,
+    weights: Optional[dict] = None,
+    use_greed: bool = False,
+    mesh=None,
+    profiles=None,
+    extenders=None,
+    journal=None,
+    resume: bool = False,
+    sweep_mode: str = "auto",
+) -> Optional[CapacityPlan]:
     """Minimum clones of `new_node` so every pod schedules and utilization
     gates pass. Returns None if even max_new_nodes doesn't suffice.
 

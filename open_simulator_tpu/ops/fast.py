@@ -78,6 +78,7 @@ from .kernels import (
     schedule_step,
 )
 from .sanitize import sanitizable
+from . import delta as _delta
 from .state import pod_rows_from_batch
 from ..utils import metrics as _metrics
 
@@ -1833,6 +1834,125 @@ def schedule_scenarios(
     return jax.vmap(one)(valid_s, carry_s, weights_s)
 
 
+# ---------------------------------------------------------------------------
+# Chunked commit driver: preemption-safe execution (docs/durability.md)
+# ---------------------------------------------------------------------------
+
+def commit_chunk_size() -> int:
+    """Pods per chunk for the chunked commit driver (`OSIM_COMMIT_CHUNK`).
+    0 (the default) keeps the monolithic single-scan dispatch. Any positive
+    value splits the per-pod commit scan into an outer host loop of
+    fixed-size chunks so a long plan can checkpoint between chunks — the
+    chunk size is the rung: every chunk call compiles ONE program per
+    (node-bucket, chunk) pair regardless of total pod count."""
+    try:
+        return max(0, int(os.environ.get("OSIM_COMMIT_CHUNK", "0") or 0))
+    except ValueError:
+        return 0
+
+
+def scenario_carry_digest(carry_s: Carry) -> int:
+    """Digest of a (stacked) carry: per-leaf device `digest_fold` reductions
+    chained in Carry._fields order. Only S 4-byte scalars transfer; the
+    result is bit-identical to `scenario_carry_digest_host` over the
+    device_get of the same carry (delta.digest_fold_host is the numpy twin),
+    which is what lets a resumed process verify a snapshot without a
+    device round-trip."""
+    parts = [_delta.digest_fold(getattr(carry_s, f)) for f in Carry._fields]
+    return _delta.combine_digests(int(jax.device_get(p)) for p in parts)
+
+
+def scenario_carry_digest_host(leaves: dict) -> int:
+    """Host twin of scenario_carry_digest over {field: np.ndarray} leaves."""
+    return _delta.combine_digests(
+        _delta.digest_fold_host(np.asarray(leaves[f])) for f in Carry._fields
+    )
+
+
+def carry_to_host(carry_s: Carry) -> dict:
+    """device_get every Carry leaf -> {field: np.ndarray} (snapshot form)."""
+    got = jax.device_get(carry_s)
+    return {f: np.asarray(getattr(got, f)) for f in Carry._fields}
+
+
+def carry_from_host(carry_s: Carry, leaves: dict) -> Carry:
+    """Re-pin host snapshot leaves onto the CURRENT carry's shardings.
+
+    `carry_s` is whatever the resumed (or recovering) process built for the
+    mesh it has NOW — its values are discarded; only its per-leaf
+    NamedShardings are kept. This is the elastic-resume step: a snapshot
+    taken on a 4-device mesh lands on 2 devices or plain CPU by being
+    device_put against the new layout, and the commit arithmetic is
+    sharding-independent (PR 14's digest-identical lanes), so the resumed
+    plan stays byte-identical."""
+    for f in Carry._fields:
+        cur = getattr(carry_s, f)
+        want = tuple(cur.shape)
+        have = tuple(np.asarray(leaves[f]).shape)
+        if want != have:
+            raise ValueError(
+                f"carry snapshot leaf {f!r} has shape {have}, current plan "
+                f"expects {want} — snapshot is from a different plan shape"
+            )
+    return Carry(*(
+        jax.device_put(
+            np.asarray(leaves[f]), getattr(carry_s, f).sharding
+        )
+        for f in Carry._fields
+    ))
+
+
+@sanitizable("ops.fast:schedule_scenarios_chunked", donate_argnums=(1,))
+@functools.partial(jax.jit, donate_argnums=(1,))
+def schedule_scenarios_chunked(
+    ns: NodeStatic,
+    carry_s: Carry,
+    pods: PodRow,
+    weights_s: jnp.ndarray,
+    valid_s: jnp.ndarray,
+    count: jnp.ndarray,
+    filter_on=None,
+):
+    """One fixed-size chunk of the scenario commit scan, count-gated.
+
+    Per-step arithmetic is exactly schedule_scenarios' (the same
+    schedule_step under the same vmap); the only addition is the `count`
+    gate: step i with i >= count is a pad step whose carry writes are
+    masked out leaf-by-leaf (`jnp.where` on every Carry leaf — for live
+    steps the where selects the new value bitwise, so real steps are
+    untouched). Chaining ceil(P/C) chunk calls over a pod sequence padded
+    to a multiple of C therefore yields a final carry and (host-trimmed)
+    outputs byte-identical to the single monolithic scan — the property
+    tests/test_checkpoint.py asserts by digest across seeds.
+
+    Pad-step OUTPUTS are garbage by design: pads only ever trail the last
+    chunk, and the host driver trims them before concatenating. `count` is
+    a traced i32 scalar so the partial last chunk reuses the full chunk's
+    compiled program (one program per (N, C) shape, rung-disciplined).
+    `carry_s` is donated, exactly like schedule_scenarios."""
+    p_chunk = jax.tree_util.tree_leaves(pods)[0].shape[0]
+    idx = jnp.arange(p_chunk, dtype=jnp.int32)
+
+    def one(valid, carry, weights):
+        ns_s = ns._replace(valid=valid)
+
+        def step(c, xs):
+            i, pod = xs
+            c2, out = schedule_step(ns_s, weights, c, pod, filter_on)
+            live = i < count
+            c2 = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(live, new, old), c2, c
+            )
+            return c2, out
+
+        final, (nodes, reasons, gpu_take, vg_take, dev_take) = jax.lax.scan(
+            step, carry, (idx, pods)
+        )
+        return final, nodes, reasons, gpu_take, vg_take, dev_take
+
+    return jax.vmap(one)(valid_s, carry_s, weights_s)
+
+
 @sanitizable("ops.fast:schedule_universes", donate_argnums=(1,))
 @functools.partial(jax.jit, donate_argnums=(1,))
 def schedule_universes(
@@ -1891,12 +2011,25 @@ def schedule_scenarios_host(
     stacked carry is the big resident tensor of a sweep, and XLA reuses its
     buffers for the output carry). Callers must rebind — the stacked carry
     from ops.state.stack_carry is freshly materialized per sweep, so the
-    simulator's own serial carry is never at risk."""
+    simulator's own serial carry is never at risk.
+
+    With OSIM_COMMIT_CHUNK > 0 (and more pods than one chunk) the dispatch
+    is the chunked commit driver instead: ceil(P/C) count-gated
+    schedule_scenarios_chunked calls whose chained result is byte-identical
+    to the single scan, with a checkpoint hook between chunks
+    (durable/checkpoint.py) and device-fault recovery — see
+    docs/durability.md."""
     rows = pod_rows_from_batch(batch)
     s_pad = int(valid_s.shape[0])
     key = (int(ns.valid.shape[0]), int(batch.p))
     _SCENARIO_PROGRAMS.setdefault(key, set()).add(s_pad)
     _metrics.SCENARIOS_PER_CALL.observe(s_real)
+    chunk = commit_chunk_size()
+    if chunk and int(batch.p) > chunk:
+        return _schedule_scenarios_chunked_host(
+            ns, carry_s, rows, weights_s, valid_s, s_real, s_pad,
+            int(batch.p), chunk, filter_on,
+        )
     _progress(
         f"scenarios S={s_real}/{s_pad} P={batch.p} N={ns.valid.shape[0]}"
     )
@@ -1905,3 +2038,131 @@ def schedule_scenarios_host(
     )
     got = jax.device_get((nodes, reasons, gpu_take, vg_take, dev_take))
     return (carry_s,) + tuple(np.asarray(a)[:s_real] for a in got)
+
+
+def _schedule_scenarios_chunked_host(
+    ns: NodeStatic,
+    carry_s: Carry,
+    rows: PodRow,
+    weights_s: jnp.ndarray,
+    valid_s: jnp.ndarray,
+    s_real: int,
+    s_pad: int,
+    p_real: int,
+    chunk: int,
+    filter_on=None,
+):
+    """The outer host loop of the chunked commit driver.
+
+    Per chunk: optional device-fault injection, one
+    schedule_scenarios_chunked dispatch, host transfer of the chunk's
+    outputs, then the checkpoint hook (journal `plan_chunk` + periodic
+    atomic carry snapshot, durable/checkpoint.py). On resume the active
+    checkpointer hands back a verified snapshot: the loop re-pins its carry
+    onto the current mesh (carry_from_host), counts the covered chunks as
+    skipped, and re-executes only the journal tail — cross-checking every
+    re-executed chunk's digest against the journaled one. A DeviceLostError
+    from the fault plane rolls back to the last good in-memory snapshot and
+    replays (degraded, not failed) until the strike budget runs out."""
+    from ..durable import checkpoint as _checkpoint
+    from ..resilience import faults as _faults
+    from ..utils import flightrec as _flightrec
+
+    N = int(ns.valid.shape[0])
+    n_chunks = -(-p_real // chunk)
+    p_pad = n_chunks * chunk
+    if p_pad != p_real:
+        rows = jax.tree_util.tree_map(
+            lambda a: jnp.concatenate(
+                [a, jnp.broadcast_to(a[:1], (p_pad - p_real,) + a.shape[1:])]
+            ),
+            rows,
+        )
+    _SCENARIO_PROGRAMS.setdefault((N, chunk), set()).add(s_pad)
+
+    cp = _checkpoint.active_checkpointer()
+    plan = None
+    start_chunk = 0
+    outs: list = []  # host (nodes, reasons, gpu, vg, dev) tuples, in order
+    if cp is not None:
+        plan = cp.begin_plan(
+            n_nodes=N, p_real=p_real, s_pad=s_pad, chunk=chunk,
+            n_chunks=n_chunks,
+        )
+        restore = plan.restore
+        if restore is not None:
+            start_chunk = restore.chunks_done
+            carry_s = carry_from_host(carry_s, restore.carry)
+            outs.append(restore.outputs)
+            _metrics.RESUME_CHUNKS_SKIPPED.inc(start_chunk)
+            _flightrec.note(
+                "plan-restore", plan=plan.key, chunk=start_chunk - 1,
+                digest=f"{restore.digest:08x}",
+            )
+            _flightrec.dump("chunk-restore", run_dir=cp.run_dir)
+
+    # Device-loss recovery needs a host-resident rollback point; pay for it
+    # only when a checkpointer is active or a device fault can actually fire.
+    track = cp is not None or _faults.has_rules("device")
+    last_good = None  # (chunk_idx, host carry leaves, len(outs), digest)
+    if track:
+        host0 = carry_to_host(carry_s)
+        last_good = (
+            start_chunk, host0, len(outs), scenario_carry_digest_host(host0),
+        )
+    strikes = 0
+
+    i = start_chunk
+    while i < n_chunks:
+        rule = _faults.maybe_inject("device", f"commit-chunk:{i}")
+        if rule is not None:
+            try:
+                _faults.apply_device_fault(rule)
+            except _faults.DeviceLostError:
+                strikes += 1
+                if last_good is None or strikes >= 3:
+                    _metrics.DEVICE_LOST.inc(handled="no")
+                    raise
+                _metrics.DEVICE_LOST.inc(handled="yes")
+                g_chunk, g_carry, g_outs, g_digest = last_good
+                _flightrec.note(
+                    "device-lost", chunk=i, restored_to=g_chunk,
+                    digest=f"{g_digest:08x}",
+                )
+                _flightrec.dump(
+                    "device-lost",
+                    run_dir=cp.run_dir if cp is not None else None,
+                )
+                carry_s = carry_from_host(carry_s, g_carry)
+                del outs[g_outs:]
+                i = g_chunk
+                continue
+        lo = i * chunk
+        count = min(chunk, p_real - lo)
+        _progress(
+            f"scenarios S={s_real}/{s_pad} N={N} "
+            f"chunk {i + 1}/{n_chunks} (C={chunk}, live={count})"
+        )
+        rows_c = jax.tree_util.tree_map(lambda a: a[lo:lo + chunk], rows)
+        carry_s, nodes, reasons, gpu_take, vg_take, dev_take = (
+            schedule_scenarios_chunked(
+                ns, carry_s, rows_c, weights_s, valid_s,
+                jnp.int32(count), filter_on,
+            )
+        )
+        got = jax.device_get((nodes, reasons, gpu_take, vg_take, dev_take))
+        outs.append(tuple(np.asarray(a)[:, :count] for a in got))
+        _metrics.PLAN_CHUNKS.inc()
+        if cp is not None:
+            digest = scenario_carry_digest(carry_s)
+            hostc = cp.on_chunk(plan, i, lo + count, digest, carry_s, outs)
+            if hostc is not None:
+                last_good = (i + 1, hostc, len(outs), digest)
+        i += 1
+
+    if cp is not None:
+        cp.finish_plan(plan, scenario_carry_digest(carry_s))
+    cat = tuple(
+        np.concatenate([o[k] for o in outs], axis=1) for k in range(5)
+    )
+    return (carry_s,) + tuple(a[:s_real] for a in cat)

@@ -8,11 +8,13 @@ degraded vs. what failed.
 """
 
 from .faults import (
+    DeviceLostError,
     FaultInjectionError,
     FaultInjector,
     FaultPlan,
     FaultRule,
     active_injector,
+    has_rules,
     injected,
     install_plan,
     maybe_inject,
@@ -31,6 +33,7 @@ from .policy import (
 __all__ = [
     "CircuitBreaker",
     "CircuitOpenError",
+    "DeviceLostError",
     "FaultInjectionError",
     "FaultInjector",
     "FaultPlan",
@@ -40,6 +43,7 @@ __all__ = [
     "active_injector",
     "breaker_for",
     "breaker_states",
+    "has_rules",
     "injected",
     "install_plan",
     "maybe_inject",

@@ -16,19 +16,21 @@ Plan schema:
     rules:
       - target: extender          # extender | kubeclient | chart
                                   # | backend | journal | admission
-                                  # | resident
+                                  # | resident | device
         op: filter                # optional substring match on the call's
                                   # operation (extender verb, api path,
                                   # chart release/path, backend stage,
                                   # journal event, admission phase
                                   # "submit"/"drain", resident phase
-                                  # "apply"/"verify"/"fence"); empty = any
+                                  # "apply"/"verify"/"fence", device chunk
+                                  # "commit-chunk:<i>"); empty = any
         kind: connection_error    # latency | connection_error | http_error
                                   # | malformed_json | error | kill
                                   # | queue_full | slow_drain
                                   # | deadline_storm  (admission only)
                                   # | torn_delta | stale_generation
                                   # | digest_mismatch  (resident only)
+                                  # | device_lost | chunk_kill (device only)
         times: 2                  # inject on the first 2 matching calls
                                   # (omit = every matching call)
         after: 0                  # skip this many matching calls first
@@ -57,17 +59,25 @@ from ..utils import metrics
 
 TARGETS = (
     "extender", "kubeclient", "chart", "backend", "journal", "admission",
-    "resident",
+    "resident", "device",
 )
 KINDS = (
     "latency", "connection_error", "http_error", "malformed_json", "error",
     "kill", "queue_full", "slow_drain", "deadline_storm",
     "torn_delta", "stale_generation", "digest_mismatch",
+    "device_lost", "chunk_kill",
 )
 
 
 class FaultInjectionError(Exception):
     """A fault plan could not be loaded or is invalid."""
+
+
+class DeviceLostError(Exception):
+    """The accelerator holding the resident carry disappeared mid-plan
+    (preemption, ICI partition, tunnel death). Raised by the `device_lost`
+    fault kind; the chunked commit driver handles it by restoring the last
+    checkpointed carry and replaying, or re-raises once out of budget."""
 
 
 @dataclass
@@ -335,6 +345,17 @@ def maybe_inject(
     return inj.intercept(target, op, key=key)
 
 
+def has_rules(target: str) -> bool:
+    """True when an installed plan names any rule for `target`. Call sites
+    that must pay extra bookkeeping to make a fault recoverable (the chunked
+    commit driver keeps a host copy of the carry only when a device fault
+    can actually fire) use this to keep the production path free."""
+    inj = _active
+    return inj is not None and any(
+        r.target == target for r in inj.plan.rules
+    )
+
+
 def snapshot_key(key: str) -> Optional[List[Tuple[int, int]]]:
     """Snapshot `key`'s fault counters (None with no active plan)."""
     inj = _active
@@ -422,6 +443,27 @@ def apply_backend_fault(rule: FaultRule) -> None:
     if rule.kind == "kill":
         os.kill(os.getpid(), 9)
     raise RuntimeError(f"injected by fault plan ({rule.kind}): backend init failed")
+
+
+def apply_device_fault(rule: FaultRule) -> None:
+    """Device faults model accelerator churn against the chunked commit
+    driver (ops/fast.py). `chunk_kill` SIGKILLs the process *before* the
+    chunk's `plan_chunk` record is journaled — the deterministic mid-plan
+    preemption the crash-resume smoke kills with; `device_lost` raises
+    DeviceLostError as if the backend dropped the resident carry, which the
+    driver recovers from its last good host snapshot (degraded, not
+    failed). Other kinds degrade to DeviceLostError too."""
+    import time as _time
+
+    if rule.kind == "latency":
+        if rule.latency_s > 0:
+            _time.sleep(rule.latency_s)
+        return
+    if rule.kind in ("chunk_kill", "kill"):
+        os.kill(os.getpid(), 9)
+    raise DeviceLostError(
+        f"injected by fault plan ({rule.kind}): device lost mid-plan"
+    )
 
 
 def apply_journal_fault(rule: FaultRule) -> None:

@@ -116,9 +116,15 @@ class RetryPolicy:
             return min(self.cap_s, self.rng.uniform(lo, hi))
 
     def _attempt_timeout(self, start: float) -> Optional[float]:
+        """Per-attempt budget: min(per_attempt_timeout_s, remaining
+        deadline). The deadline clamp floors at 0 — once the budget is
+        blown, `remaining` is negative, and handing a negative/zero timeout
+        to a transport (which commonly treats <=0 as *unbounded*) would let
+        one attempt overshoot the whole deadline. execute() refuses to
+        launch an attempt whose clamped budget is 0."""
         timeout = self.per_attempt_timeout_s
         if self.deadline_s is not None:
-            remaining = self.deadline_s - (self.clock() - start)
+            remaining = max(0.0, self.deadline_s - (self.clock() - start))
             timeout = remaining if timeout is None else min(timeout, remaining)
         return timeout
 
@@ -132,11 +138,20 @@ class RetryPolicy:
         start = self.clock()
         delay = self.base_s
         attempt = 0
+        last_exc: BaseException = TimeoutError(
+            f"retry deadline of {self.deadline_s}s exhausted before an "
+            "attempt could start"
+        )
         while True:
             attempt += 1
+            timeout = self._attempt_timeout(start)
+            if timeout is not None and timeout <= 0:
+                # deadline exhausted before this attempt could launch
+                raise RetryExhaustedError(last_exc, attempt - 1)
             try:
-                return fn(self._attempt_timeout(start))
+                return fn(timeout)
             except retryable as e:
+                last_exc = e
                 if attempt >= self.max_attempts:
                     raise RetryExhaustedError(e, attempt)
                 delay = self.next_delay(delay)

@@ -517,6 +517,27 @@ RUN_RESUMED = REGISTRY.counter(
     "osim_run_resumed_total",
     "Runs resumed from a journal (apply/bench --resume).",
 )
+PLAN_CHUNKS = REGISTRY.counter(
+    "osim_plan_chunks_total",
+    "Commit chunks executed by the chunked scenario driver "
+    "(OSIM_COMMIT_CHUNK > 0).",
+)
+CHECKPOINT_BYTES = REGISTRY.counter(
+    "osim_checkpoint_bytes",
+    "Bytes atomically persisted in mid-plan carry snapshots.",
+)
+RESUME_CHUNKS_SKIPPED = REGISTRY.counter(
+    "osim_resume_chunks_skipped_total",
+    "Commit chunks a resumed plan restored from a snapshot instead of "
+    "re-executing.",
+)
+DEVICE_LOST = REGISTRY.counter(
+    "osim_device_lost_total",
+    "Device-loss events seen by the chunked commit driver; handled=yes "
+    "means the carry was restored from the last good snapshot and the plan "
+    "continued.",
+    labelnames=("handled",),
+)
 JOURNAL_EVENTS = REGISTRY.counter(
     "osim_journal_events_total",
     "Records durably committed to run journals, by event type.",

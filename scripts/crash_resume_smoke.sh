@@ -138,3 +138,79 @@ assert "run_end" in events[i:], f"resume never completed: {events}"
 print(f"crash-resume smoke OK (batched): {pre} sweep record(s) replayed "
       f"with zero re-run scenarios, {post} re-run, outcome byte-identical")
 EOF
+
+# ---------------------------------------------------------------------------
+# Mid-chunk leg (docs/durability.md): the kill now lands INSIDE a batched
+# device call. With OSIM_COMMIT_CHUNK the commit scan is a host loop of
+# chunk dispatches, each journaled (`plan_chunk`) and periodically
+# snapshotted — so a SIGKILL between chunks loses at most one chunk, not
+# the whole plan. Resume restores the newest verified snapshot, replays
+# the journal tail with per-chunk digest cross-checks, and the final
+# outcome must STILL byte-match the unchunked reference from step 6 —
+# proving chunked == monolithic and crash == clean in one cmp.
+# ---------------------------------------------------------------------------
+export OSIM_COMMIT_CHUNK=8 OSIM_CKPT_EVERY=2
+
+# 11. Crash run: a device-plane chunk_kill SIGKILLs the sweep at commit
+#     chunk 3 of the first chunked plan — after chunks 0-2 journaled and
+#     the chunks 0-1 snapshot hit the disk.
+cat > "$SCRATCH/chunk-faults.yaml" <<'EOF'
+rules:
+  - target: device
+    op: "commit-chunk:3"
+    kind: chunk_kill
+    times: 1
+EOF
+rc=0
+OSIM_FAULT_PLAN="$SCRATCH/chunk-faults.yaml" \
+    python -m open_simulator_tpu.cli.main sweep -f "$SWEEP_CFG" --capacity \
+    --run-dir "$SCRATCH/chunkcrash" > /dev/null 2>&1 || rc=$?
+if [ "$rc" -ne 137 ]; then
+    echo "expected a mid-chunk SIGKILL (rc 137), got rc=$rc"; exit 1
+fi
+[ -f "$SCRATCH/chunkcrash/outcome.json" ] && { echo "mid-chunk-killed sweep wrote an outcome?"; exit 1; }
+
+# 12. The journal must already hold per-chunk records and ckpt/ a snapshot:
+#     the whole point is that the death happened mid-plan, not between plans.
+python - "$SCRATCH/chunkcrash" <<'EOF'
+import glob, os, sys
+from open_simulator_tpu.durable import replay
+chunks = [e for e in replay(sys.argv[1]) if e["event"] == "plan_chunk"]
+assert chunks, "no plan_chunk records: the chunked driver never engaged"
+snaps = glob.glob(os.path.join(sys.argv[1], "ckpt", "plan-*.npz"))
+assert snaps, "no carry snapshot on disk at kill time"
+EOF
+
+# 13. Resume (same chunk env: plan keys embed the chunk size).
+python -m open_simulator_tpu.cli.main runs resume "$SCRATCH/chunkcrash" > /dev/null
+
+# 14. Byte-identity against the UNCHUNKED reference of step 6.
+cmp "$SCRATCH/sweepref/outcome.json" "$SCRATCH/chunkcrash/outcome.json" || {
+    echo "mid-chunk resumed outcome differs from the monolithic run:"
+    diff "$SCRATCH/sweepref/outcome.json" "$SCRATCH/chunkcrash/outcome.json" || true
+    exit 1
+}
+
+# 15. The resume actually skipped the snapshotted chunks (a chunk-restore
+#     flight-recorder artifact names the restore point) and re-journaled
+#     only the tail — no duplicate plan_chunk records.
+python - "$SCRATCH/chunkcrash" <<'EOF'
+import collections, glob, json, os, sys
+from open_simulator_tpu.durable import replay
+run = sys.argv[1]
+arts = glob.glob(os.path.join(run, "flightrec-chunk-restore-*.json"))
+assert arts, "resume left no chunk-restore flight-recorder artifact"
+notes = [e for a in arts for e in json.load(open(a))["events"]
+         if e.get("kind") == "plan-restore"]
+assert notes, "no plan-restore note in the artifact"
+int(notes[-1]["digest"], 16)
+seen = collections.Counter(
+    (e["plan"], e["chunk"]) for e in replay(run) if e["event"] == "plan_chunk"
+)
+dupes = {k: n for k, n in seen.items() if n > 1}
+assert not dupes, f"duplicate plan_chunk records after resume: {dupes}"
+print(f"crash-resume smoke OK (mid-chunk): restored at chunk "
+      f"{notes[-1]['chunk'] + 1} (digest {notes[-1]['digest']}), "
+      f"{len(seen)} chunk records, outcome byte-identical to monolithic")
+EOF
+unset OSIM_COMMIT_CHUNK OSIM_CKPT_EVERY

@@ -177,6 +177,53 @@ def test_deadline_budget_aborts_instead_of_oversleeping():
     assert sum(slept) <= 2.5                  # never slept past the deadline
 
 
+def test_per_attempt_timeout_clamped_to_remaining_deadline():
+    # Regression: per_attempt_timeout_s used to be handed to fn untouched,
+    # so one attempt could overshoot the whole deadline (a transport given
+    # timeout=10 against a 4s deadline hangs for 10).
+    now = [0.0]
+    budgets = []
+
+    def fn(timeout):
+        budgets.append(timeout)
+        now[0] += timeout  # the attempt burns its entire budget
+        raise ValueError("slow")
+
+    p = RetryPolicy(
+        max_attempts=5, base_s=1.0, cap_s=1.0, per_attempt_timeout_s=10.0,
+        deadline_s=4.0, rng=random.Random(0), clock=lambda: now[0],
+        sleep=lambda s: now.__setitem__(0, now[0] + s),
+    )
+    with pytest.raises(RetryExhaustedError):
+        p.execute(fn, retryable=(ValueError,))
+    assert budgets[0] == 4.0                  # min(10, remaining 4), not 10
+    assert all(0 < b <= 4.0 for b in budgets)
+    assert now[0] <= p.deadline_s + p.cap_s   # no attempt overshot the budget
+
+
+def test_blown_deadline_refuses_to_launch_attempt():
+    # Regression: with the deadline exactly consumed and a zero backoff, the
+    # next attempt used to launch with a clamped timeout of 0 — which most
+    # transports treat as *unbounded*. It must be refused instead.
+    now = [0.0]
+    calls = []
+
+    def fn(timeout):
+        calls.append(timeout)
+        now[0] += 2.0  # consumes the whole deadline
+        raise ValueError("hang")
+
+    p = RetryPolicy(
+        max_attempts=3, base_s=0.0, cap_s=0.0, deadline_s=2.0,
+        rng=random.Random(0), clock=lambda: now[0], sleep=lambda s: None,
+    )
+    with pytest.raises(RetryExhaustedError) as ei:
+        p.execute(fn, retryable=(ValueError,))
+    assert calls == [2.0]                     # exactly one attempt launched
+    assert ei.value.attempts == 1
+    assert isinstance(ei.value.last_exc, ValueError)
+
+
 def test_from_env_knobs(monkeypatch):
     monkeypatch.setenv("OSIM_RETRY_MAX_ATTEMPTS", "5")
     monkeypatch.setenv("OSIM_RETRY_BASE_S", "0.01")
