@@ -1696,6 +1696,43 @@ def config_prove_smoke(n_universes=512):
     return out
 
 
+def config_interleave_smoke():
+    """The `simon interleave` protocol model checker under quick bounds:
+    explored-states throughput of the cooperative-scheduler explorer
+    over all five protocol scenarios. The report itself is
+    wall-clock-free by design (same seed => byte-identical), so the
+    timing lives here, bench-side. Any invariant violation on the real
+    protocols — or a scenario exhausting its run budget — is an error."""
+    from open_simulator_tpu.analysis.interleave import run_interleave
+
+    out = {}
+    t0 = time.time()
+    report = run_interleave(quick=True)
+    wall = time.time() - t0
+    states = sum(s.states for s in report.scenarios)
+    out["wall_s"] = round(wall, 2)
+    out["runs"] = sum(s.runs for s in report.scenarios)
+    out["states"] = states
+    out["pruned"] = sum(s.pruned for s in report.scenarios)
+    out["scenarios"] = {
+        s.name: {"runs": s.runs, "states": s.states,
+                 "completed": s.completed}
+        for s in report.scenarios
+    }
+    out["digest"] = report.to_dict()["digest"]
+    out["value"] = round(states / wall, 1)
+    out["unit"] = "states/s"
+    if not report.ok:
+        bad = [f"{s.name}:{v.invariant}"
+               for s in report.scenarios for v in s.violations]
+        incomplete = [s.name for s in report.scenarios if not s.completed]
+        out["error"] = (
+            f"interleave not clean on real protocols: "
+            f"violations={bad} budget-exhausted={incomplete}"
+        )
+    return out
+
+
 def config_plan_200k_20k():
     """CPU-scaled million-node segment: 200k pods / 20k nodes (CI publishes
     this one; plan_1m_100k is the full-scale variant)."""
@@ -1830,6 +1867,7 @@ CONFIGS = {
     "serving_saturation": config_serving_saturation,
     "resident_delta_10k": config_resident_delta_10k,
     "prove_smoke": config_prove_smoke,
+    "interleave_smoke": config_interleave_smoke,
     "plan_200k_20k": config_plan_200k_20k,
     "plan_1m_100k": config_plan_1m_100k,
     "checkpoint_overhead": config_checkpoint_overhead,

@@ -343,7 +343,48 @@ def _thread_target_exprs(node: ast.Call) -> Tuple[List[ast.expr], str]:
         return [node.args[1]], "signal handler"
     if callee == "Timer" and len(node.args) >= 2:
         return [node.args[1]], "timer thread"
+    if callee == "guarded_call" and len(node.args) >= 2:
+        # guarded_call(stage, fn, deadline) runs fn on a daemon watchdog
+        # worker thread (durable/watchdog.py) whenever a deadline is armed
+        # — every checkpoint/resume driver's device call routes through it
+        return [node.args[1]], "watchdog-guarded call"
     return [], ""
+
+
+#: subprocess entry points that put a child process to work while the
+#: parent keeps running (the `simon chaos --capacity` kill/resume driver):
+#: the wrapper coordinates with the child through the run journal and
+#: environment, so it is audited like a thread root.
+_SUBPROCESS_LAUNCHES = {"run", "Popen", "call", "check_call", "check_output"}
+
+
+def _is_subprocess_launch(mod: ModuleInfo, node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        if f.attr not in _SUBPROCESS_LAUNCHES:
+            return False
+        imp = mod.imports.get(f.value.id)
+        return imp is not None and imp[0] == "subprocess" and imp[1] is None
+    if isinstance(f, ast.Name):
+        imp = mod.imports.get(f.id)
+        return (
+            imp is not None
+            and imp[0] == "subprocess"
+            and imp[1] in _SUBPROCESS_LAUNCHES
+        )
+    return False
+
+
+def _own_body(info: FunctionInfo) -> Iterator[ast.AST]:
+    """A function's own statements, nested defs excluded (those carry
+    their own FunctionInfo and attribute their own calls)."""
+    stack = list(ast.iter_child_nodes(info.node))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
 
 
 def _qualnames(mod: ModuleInfo) -> Dict[str, FunctionInfo]:
@@ -403,6 +444,19 @@ def thread_roots(ctx: LintContext) -> Dict[Tuple[str, str], str]:
                         roots[(mod.name, qual)] = (
                             f"{reason} {mod.name}:{qual}"
                         )
+        # 4. subprocess wrappers: a function that launches a child process
+        # keeps running concurrently with it, coordinating through the
+        # journal/run-dir/env (`simon chaos --capacity` SIGKILLs the child
+        # mid-chunk and resumes from its on-disk state) — audit the
+        # wrapper itself like a thread root
+        for info in quals.values():
+            if any(
+                isinstance(n, ast.Call) and _is_subprocess_launch(mod, n)
+                for n in _own_body(info)
+            ):
+                roots[(mod.name, info.qualname)] = (
+                    f"subprocess wrapper {mod.name}:{info.qualname}"
+                )
     return roots
 
 
@@ -464,10 +518,14 @@ def _calls_from(
 
 
 def audited_functions(
-    ctx: LintContext, roots: Dict[Tuple[str, str], str]
+    ctx: LintContext, roots: Dict[Tuple[str, str], str],
+    module_hosts: bool = True,
 ) -> Dict[Tuple[str, str], str]:
-    """Thread-reachable closure of the roots, plus every function in a
-    module that defines a root (main-thread code racing the handlers)."""
+    """Thread-reachable closure of the roots, plus (when ``module_hosts``)
+    every function in a module that defines a root (main-thread code
+    racing the handlers). ``module_hosts=False`` gives the strict
+    reachability closure — what the ``lock-in-hot-path`` lint rule wants:
+    only code that actually runs on a hot thread."""
     audited: Dict[Tuple[str, str], str] = {}
     index = _method_index(ctx)
     work = [(key, reason) for key, reason in sorted(roots.items())]
@@ -488,6 +546,8 @@ def audited_functions(
             if tgt not in audited:
                 work.append((tgt, reason))
 
+    if not module_hosts:
+        return audited
     root_modules = {m for (m, _q) in roots}
     for mod_name in root_modules:
         mod = ctx.modules[mod_name]

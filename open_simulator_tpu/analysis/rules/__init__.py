@@ -7,6 +7,15 @@ the contract they guard:
 * ``dtype``        — f32/i32 regime in ``ops/``
 * ``shapes``       — jit-entry shape args flow through bucketing helpers
 * ``device_sync``  — host loops feeding jit entries stay sync-free
+* ``hotpath``      — no per-call lock construction on handler/scheduler
+  threads (lock identity must be module- or instance-lifetime)
 """
 
-from . import device_sync, dtype, jit_contracts, purity, shapes  # noqa: F401
+from . import (  # noqa: F401
+    device_sync,
+    dtype,
+    hotpath,
+    jit_contracts,
+    purity,
+    shapes,
+)

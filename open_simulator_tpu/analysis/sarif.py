@@ -1,0 +1,272 @@
+"""SARIF 2.1.0 emission for the `simon check` umbrella verb.
+
+Every static pass in the repo already emits a deterministic JSON report
+with its own shape (`simon lint`, `simon audit`, `simon preflight`,
+`simon interleave`). CI annotation UIs, though, speak one language:
+SARIF. This module converts each pass's report into a SARIF *run* (one
+``tool.driver`` per producer, so annotations are attributed to the pass
+that found them) and `sarif_document` stitches the runs into a single
+2.1.0 document.
+
+Shape conventions:
+
+* one SARIF ``run`` per producer (``simon-lint``, ``simon-audit``,
+  ``simon-preflight``, ``simon-interleave``), even when a producer has
+  zero results — the empty run is the machine-readable "this pass ran
+  and was clean" statement;
+* findings with a source position (lint, races) carry a
+  ``physicalLocation``; report-level findings (budget violations,
+  interleaving violations) anchor to the subsystem file they indict so
+  annotation UIs still have somewhere to pin them;
+* all output is plain dicts ordered for ``json.dumps(sort_keys=True)``
+  byte-stability — no wall-clock, no randomness.
+
+The converters take the pass report *objects* (duck-typed: only
+``to_dict``-adjacent attributes are touched) so `simon check` can run
+the passes in-process and hand the results straight over.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = "https://json.schemastore.org/sarif-2.1.0.json"
+_INFO_URI = "https://github.com/open-simulator/open-simulator"
+
+#: where a location-less interleave violation is pinned: the module whose
+#: protocol the scenario exercises (see analysis/interleave.py SCENARIOS).
+SCENARIO_SUBJECTS = {
+    "admission": "open_simulator_tpu/server/admission.py",
+    "fence": "open_simulator_tpu/server/loop.py",
+    "session": "open_simulator_tpu/server/server.py",
+    "journal": "open_simulator_tpu/durable/journal.py",
+    "breaker": "open_simulator_tpu/resilience/policy.py",
+}
+
+
+def _location(path: str, line: int = 0, col: int = 0) -> dict:
+    region: dict = {}
+    if line:
+        region["startLine"] = int(line)
+    if col:
+        # SARIF columns are 1-based; the AST passes report 0-based cols.
+        region["startColumn"] = int(col) + 1
+    loc: dict = {
+        "physicalLocation": {
+            "artifactLocation": {"uri": path, "uriBaseId": "SRCROOT"}
+        }
+    }
+    if region:
+        loc["physicalLocation"]["region"] = region
+    return loc
+
+
+def _result(
+    rule_id: str,
+    message: str,
+    *,
+    level: str = "error",
+    path: str = "",
+    line: int = 0,
+    col: int = 0,
+    properties: Optional[dict] = None,
+) -> dict:
+    res: dict = {
+        "ruleId": rule_id,
+        "level": level,
+        "message": {"text": message},
+    }
+    if path:
+        res["locations"] = [_location(path, line, col)]
+    if properties:
+        res["properties"] = properties
+    return res
+
+
+def _run(name: str, rule_ids: List[str], results: List[dict]) -> dict:
+    return {
+        "tool": {
+            "driver": {
+                "name": name,
+                "informationUri": _INFO_URI,
+                "rules": [{"id": r} for r in sorted(set(rule_ids))],
+            }
+        },
+        "columnKind": "utf16CodeUnits",
+        "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+        "results": results,
+    }
+
+
+# ---------------------------------------------------------------------------
+# per-pass converters
+# ---------------------------------------------------------------------------
+
+def lint_run(report) -> dict:
+    """`simon lint` LintReport -> SARIF run. Suppressed findings are
+    omitted (they are the accepted-and-annotated set, not annotations)."""
+    results = [
+        _result(
+            f.rule,
+            f.message + (f" [via {f.jit_root}]" if f.jit_root else ""),
+            path=f.path,
+            line=f.line,
+            col=f.col,
+        )
+        for f in report.active
+    ]
+    return _run("simon-lint", list(report.rules), results)
+
+
+def audit_run(report) -> dict:
+    """`simon audit` SemanticAuditReport (races + invariants) -> SARIF
+    run. Unused suppressions are findings too: a stale ``audit-ok``
+    hides future regressions."""
+    results: List[dict] = []
+    rule_ids: List[str] = []
+    races = getattr(report, "races", None)
+    if races is not None:
+        for f in races.active:
+            rule_ids.append(f.rule)
+            results.append(
+                _result(
+                    f.rule,
+                    f"{f.message} [via {f.thread_root}]",
+                    path=f.path,
+                    line=f.line,
+                    col=f.col,
+                    properties={"state": f.state, "function": f.function},
+                )
+            )
+        for u in races.unused_suppressions:
+            rule_ids.append("unused-suppression")
+            results.append(
+                _result(
+                    "unused-suppression",
+                    f"unused audit suppression audit-ok[{u.rule}]",
+                    level="warning",
+                    path=u.path,
+                    line=u.line,
+                )
+            )
+    inv = getattr(report, "invariants", None)
+    if inv is not None and not inv.ok:
+        for f in inv.findings:
+            rule_ids.append(f.kind)
+            results.append(
+                _result(
+                    f.kind,
+                    f"{f.entry} at {f.path}: {f.message}",
+                    properties={"primitive": f.primitive},
+                )
+            )
+    return _run("simon-audit", rule_ids, results)
+
+
+def preflight_run(report) -> dict:
+    """`simon preflight` PreflightReport -> SARIF run. Everything is
+    report-level (budgets live in budgets/preflight.json), so results
+    anchor to the budget book."""
+    results: List[dict] = []
+    rule_ids: List[str] = []
+    anchor = report.budgets_path or "budgets/preflight.json"
+    for v in report.violations:
+        d = v.to_dict() if hasattr(v, "to_dict") else dict(v)
+        rule = str(d.get("kind", "budget"))
+        rule_ids.append(rule)
+        results.append(
+            _result(
+                rule,
+                f"{d.get('key', '?')}: {d.get('message', '')}",
+                path=anchor,
+                properties={k: d[k] for k in sorted(d)},
+            )
+        )
+    for p in report.programs:
+        if p.error:
+            rule_ids.append("lowering-error")
+            results.append(
+                _result("lowering-error", f"{p.key}: {p.error}", path=anchor)
+            )
+        elif not p.estimate_ok:
+            rule_ids.append("estimator-mismatch")
+            results.append(
+                _result(
+                    "estimator-mismatch",
+                    f"{p.key}: analytic estimator disagrees with compiled "
+                    f"argument/output sizes",
+                    path=anchor,
+                )
+            )
+    for t in report.transfers:
+        if not t.ok:
+            rule_ids.append("steady-state-transfer")
+            results.append(
+                _result(
+                    "steady-state-transfer",
+                    f"{t.entry}: host transfer in steady state"
+                    + (f" ({t.error})" if t.error else ""),
+                    path=anchor,
+                )
+            )
+    verdict = report.verdict
+    if verdict is not None and not verdict.get("ok", False):
+        rule_ids.append("plan-verdict")
+        results.append(
+            _result(
+                "plan-verdict",
+                f"plan verdict {verdict.get('config', '?')} failed: "
+                f"{verdict.get('error') or 'does not fit'}",
+                path=anchor,
+            )
+        )
+    return _run("simon-preflight", rule_ids, results)
+
+
+def interleave_run(report) -> dict:
+    """`simon interleave` InterleaveReport -> SARIF run. Violations anchor
+    to the module whose protocol the scenario drives; the minimized
+    schedule rides in the result's property bag so the annotation is
+    replayable (`simon interleave --replay`)."""
+    results: List[dict] = []
+    rule_ids: List[str] = []
+    for sc in sorted(report.scenarios, key=lambda s: s.name):
+        for v in sc.violations:
+            rule_ids.append(v.invariant)
+            results.append(
+                _result(
+                    v.invariant,
+                    f"scenario '{v.scenario}': {v.message}",
+                    path=SCENARIO_SUBJECTS.get(v.scenario, ""),
+                    line=1,
+                    properties={
+                        "scenario": v.scenario,
+                        "interventions": [list(i) for i in v.interventions],
+                        "seed": report.seed,
+                        "mutate": report.mutate or "",
+                    },
+                )
+            )
+        if not sc.completed and not sc.violations:
+            rule_ids.append("exploration-incomplete")
+            results.append(
+                _result(
+                    "exploration-incomplete",
+                    f"scenario '{sc.name}': exploration hit the run budget "
+                    f"before exhausting the interleaving space "
+                    f"({sc.runs} runs, {sc.states} states)",
+                    level="warning",
+                    path=SCENARIO_SUBJECTS.get(sc.name, ""),
+                    line=1,
+                )
+            )
+    return _run("simon-interleave", rule_ids, results)
+
+
+def sarif_document(runs: List[dict]) -> dict:
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": runs,
+    }

@@ -46,7 +46,10 @@ def load() -> Optional[ctypes.CDLL]:
     with _lock:
         if _lib is not None or _tried:
             return _lib
-        _tried = True
+        # double-checked locking: the unlocked fast-path read above pairs
+        # with these writes, but both writes happen under _lock and a stale
+        # fast-path read only costs a harmless second trip into the lock
+        _tried = True  # osim: audit-ok[race]
         if not os.path.exists(_SO) and not _build():
             return None
         try:
@@ -69,7 +72,9 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int64),
         ]
         lib.osim_parse_quantity_one.restype = ctypes.c_int
-        _lib = lib
+        # publish under _lock; the unlocked reader sees either None (and
+        # takes the lock) or the fully-initialized CDLL
+        _lib = lib  # osim: audit-ok[race]
         return _lib
 
 

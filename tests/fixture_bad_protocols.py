@@ -1,0 +1,86 @@
+"""Known-violation protocol mutations for the interleave model checker.
+
+Each entry names a seeded bug (`simon interleave --mutate <name>`) that
+swaps one real protocol routine for a deliberately-broken variant — the
+concurrency analogue of fixture_bad_kernels.py. The checker MUST catch
+every one of these, ddmin-minimize it to a replayable schedule, and
+exit nonzero, or the explorer is vacuous. Never used by production code.
+
+``invariants`` lists every acceptable first catch: the explorer stops at
+the first violating schedule it meets, and some bugs manifest as more
+than one broken invariant depending on the interleaving (e.g. the racy
+session checkout can also blow up inside the seeded bug itself, which
+surfaces as an actor-exception violation — still a legitimate catch of
+the same bug).
+"""
+
+import dataclasses
+from typing import FrozenSet
+
+
+@dataclasses.dataclass(frozen=True)
+class BadProtocol:
+    mutation: str          # --mutate name (analysis.interleave.MUTATIONS)
+    scenario: str          # scenario the mutation applies to
+    invariants: FrozenSet[str]  # acceptable violated-invariant names
+    description: str
+
+
+BAD_PROTOCOLS = (
+    BadProtocol(
+        mutation="lost-ticket",
+        scenario="admission",
+        invariants=frozenset({
+            "no-lost-ticket", "no-double-dispatch", "no-deadlock",
+        }),
+        description=(
+            "take_pack snapshots the queue under the lock but clears it "
+            "in a second acquisition — a submit landing between the two "
+            "critical sections is silently dropped (or, under other "
+            "schedules, a shed ticket is also dispatched)"
+        ),
+    ),
+    BadProtocol(
+        mutation="fence-regression",
+        scenario="fence",
+        invariants=frozenset({"fence-monotonic", "fence-stamp"}),
+        description=(
+            "the fence-epoch read is memoized one bump behind, so a pack "
+            "dequeued after an epoch bump runs (and stamps tickets) with "
+            "the stale epoch"
+        ),
+    ),
+    BadProtocol(
+        mutation="double-checkout",
+        scenario="session",
+        invariants=frozenset({"no-double-checkout", "actor-exception"}),
+        description=(
+            "the busy check and the busy set run in two separate critical "
+            "sections, so two warmers can check out the same session "
+            "(or the torn window lets an eviction slip between them, "
+            "which crashes the seeded variant itself)"
+        ),
+    ),
+    BadProtocol(
+        mutation="torn-checkpoint",
+        scenario="journal",
+        invariants=frozenset({"journal-prefix-closure"}),
+        description=(
+            "the appender acks the sequence number before the journal "
+            "write lands, so a crash between ack and append leaves an "
+            "acked record missing from the durable prefix"
+        ),
+    ),
+    BadProtocol(
+        mutation="double-probe",
+        scenario="breaker",
+        invariants=frozenset({
+            "breaker-legal-transitions", "breaker-single-probe",
+        }),
+        description=(
+            "allow() reads the breaker state outside the lock, so two "
+            "clients can both see HALF_OPEN and both probe — the "
+            "half_open->half_open transition the state machine forbids"
+        ),
+    ),
+)

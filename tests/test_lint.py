@@ -591,3 +591,100 @@ def test_repo_jit_roots_discovered():
         "open_simulator_tpu.ops.kernels:commit_step",
     ):
         assert expected in roots, f"missing jit root {expected}"
+
+
+# ---------------------------------------------------------------------------
+# lock-in-hot-path
+
+
+HOT_PREAMBLE = """
+    import threading
+"""
+
+
+def test_lock_in_hot_path_true_positive(tmp_path):
+    """A per-call Lock on a thread target (and in everything it calls)
+    synchronizes nothing and must be flagged."""
+    r = _lint(
+        tmp_path,
+        HOT_PREAMBLE + """
+    def helper():
+        guard = threading.RLock()
+        return guard
+
+    def worker():
+        lock = threading.Lock()
+        with lock:
+            helper()
+
+    threading.Thread(target=worker).start()
+    """,
+        only_rules=["lock-in-hot-path"],
+    )
+    assert sum(f.rule == "lock-in-hot-path" for f in r.active) == 2
+
+
+def test_lock_in_hot_path_instance_and_module_lifetime_ok(tmp_path):
+    """Module-level locks and instance publishes (self._lock = Lock(),
+    Condition(Lock()) wrappers included) are the sanctioned shapes."""
+    r = _lint(
+        tmp_path,
+        HOT_PREAMBLE + """
+    _lock = threading.Lock()
+
+    class Pool:
+        def worker(self):
+            self._lock = threading.Lock()
+            self._cond = threading.Condition(threading.Lock())
+            with _lock:
+                pass
+
+    threading.Thread(target=Pool().worker).start()
+    """,
+        only_rules=["lock-in-hot-path"],
+    )
+    assert not r.active, [f.render() for f in r.active]
+
+
+def test_lock_in_hot_path_cold_code_not_flagged(tmp_path):
+    """A local lock in code no thread root reaches is out of scope (the
+    module-hosts expansion the race pass uses does NOT apply here)."""
+    r = _lint(
+        tmp_path,
+        HOT_PREAMBLE + """
+    def setup_once():
+        lock = threading.Lock()
+        return lock
+
+    def worker():
+        pass
+
+    threading.Thread(target=worker).start()
+    """,
+        only_rules=["lock-in-hot-path"],
+    )
+    assert not r.active, [f.render() for f in r.active]
+
+
+def test_lock_in_hot_path_suppression(tmp_path):
+    r = _lint(
+        tmp_path,
+        HOT_PREAMBLE + """
+    def worker():
+        lock = threading.Lock()  # osim: lint-ok[lock-in-hot-path]
+        with lock:
+            pass
+
+    threading.Thread(target=worker).start()
+    """,
+        only_rules=["lock-in-hot-path"],
+    )
+    assert not r.active
+    assert sum(f.suppressed for f in r.findings) == 1
+
+
+def test_repo_clean_against_lock_in_hot_path():
+    from open_simulator_tpu.analysis import run_lint
+
+    r = run_lint(only_rules=["lock-in-hot-path"])
+    assert not r.active, [f.render() for f in r.active]

@@ -676,3 +676,82 @@ def test_race_suppression_does_not_silence_deadlock(tmp_path):
     assert any(f.rule == "deadlock" for f in rep.active)
     # and the race escape is stale: it matched no race finding
     assert len(rep.unused_suppressions) == 1
+
+
+# ---------------------------------------------------------------------------
+# PR 18 thread-root surfaces: watchdog-guarded callables + subprocess
+# wrappers
+# ---------------------------------------------------------------------------
+
+def test_guarded_call_target_is_a_thread_root(tmp_path):
+    """guarded_call(stage, fn, deadline) runs fn on a watchdog worker
+    thread; an unguarded mutation inside fn must be flagged."""
+    rep = _races(
+        tmp_path,
+        """
+    import threading
+
+    _lock = threading.Lock()
+    _progress = {}
+
+    def _sweep():
+        _progress["chunk"] = 1
+
+    def drive(guarded_call):
+        guarded_call("sweep", _sweep, 30.0)
+    """,
+    )
+    assert any("watchdog-guarded call" in r for r in rep.thread_roots)
+    assert [f.access for f in rep.active] == ["mutate"]
+    assert rep.active[0].state == "pkg.mod._progress"
+
+
+def test_subprocess_wrapper_is_a_thread_root(tmp_path):
+    """A function that launches a child process keeps running concurrently
+    with it; its own shared-state writes are audited like a thread's."""
+    rep = _races(
+        tmp_path,
+        """
+    import subprocess as _sp
+    import sys
+
+    _runs = {}
+
+    def kill_and_resume(cfg):
+        _runs[cfg] = "started"
+        _sp.run([sys.executable, "-m", "child", cfg])
+    """,
+    )
+    assert any("subprocess wrapper" in r for r in rep.thread_roots)
+    assert any(f.state == "pkg.mod._runs" for f in rep.active)
+
+
+def test_subprocess_helper_alias_not_misrooted(tmp_path):
+    """A same-named method on a non-subprocess object must not root its
+    caller (the alias has to resolve to the subprocess module)."""
+    rep = _races(
+        tmp_path,
+        """
+    class Runner:
+        def run(self, argv):
+            return argv
+
+    _state = {}
+
+    def drive(cfg):
+        _state[cfg] = 1
+        Runner().run([cfg])
+    """,
+    )
+    assert not any("subprocess wrapper" in r for r in rep.thread_roots)
+    assert rep.ok, rep.render_text()
+
+
+def test_package_roots_cover_chaos_capacity_and_checkpoint_drivers():
+    """The real repo's PR 18 surfaces: the chaos --capacity subprocess
+    wrapper and the watchdog-guarded capacity-sweep callable."""
+    rep = run_races()
+    roots = "\n".join(rep.thread_roots)
+    assert "subprocess wrapper" in roots, roots
+    assert "_run_chaos_capacity" in roots, roots
+    assert "watchdog-guarded call" in roots, roots
