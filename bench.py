@@ -1639,6 +1639,15 @@ def _config_plan_scaled(n_pods, n_nodes):
     out["nodes_added"] = plan.nodes_added if plan else -1
     out["attempts"] = plan.attempts if plan else 0
     out["batched_calls"] = plan.batched_calls if plan else 0
+    # the commit engine the sweep routed through: OSIM_WAVE_COMMIT=1 runs
+    # this whole segment on the conflict-parallel wave driver (byte-
+    # identical placements; rounds/fallbacks in `simon metrics`), the
+    # default is auto (wave only on parallel backends — see ops/wave.py)
+    from open_simulator_tpu.ops import wave as wave_mod
+
+    out["commit_engine"] = (
+        "wave" if wave_mod.wave_enabled(n_pods) else "serial"
+    )
 
     # --- distinct programs: every one on a ladder rung --------------------
     progs = scenario_programs()
@@ -1850,6 +1859,178 @@ def config_checkpoint_overhead(n_pods=10_000, n_nodes=100, chunk=1024):
     return out
 
 
+def config_wave_commit_10k(
+    n_pods=10_000, n_nodes=500, wave_pods=1_280, wave=256, wave_rounds=8
+):
+    """Config: the conflict-parallel wave commit (ops/wave.py, ROADMAP
+    item 1) against the serial scan it replaces.
+
+    Three legs:
+      1. serial oracle — the monolithic decide+commit scan over n_pods
+         (one schedule_step per pod); its warm wall is the baseline and
+         its placements/carry digest are the reference.
+      2. commit phase — the serial leg's choices replayed through
+         `ops.fast:commit_choices` (the row-wise commit scan): the only
+         inherently sequential part of the wave engine. The acceptance
+         floor is >= 10x faster than the serial scan on CPU — the
+         sequential-depth reduction the wave engine buys — and the final
+         carry must digest-match the serial leg bit-for-bit.
+      3. wave engine — the full Jacobi round driver (OSIM_WAVE_COMMIT=1)
+         over a wave_pods prefix-sized workload, reporting
+         rounds-to-converge, conflicts, and bounded-rounds fallbacks
+         from the metrics registry, plus its own serial-reference digest
+         equality. Total wall is reported, NOT gated: on a single-core
+         CPU host a probe round costs about one serial scan of the wave
+         (element-throughput-bound), so the data-parallel win needs a
+         parallel backend — docs/performance.md works the numbers.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from open_simulator_tpu.ops import fast
+    from open_simulator_tpu.ops import state as state_mod
+    from open_simulator_tpu.ops.kernels import weights_array
+    from open_simulator_tpu.utils import metrics
+
+    def msum(counter) -> float:
+        return sum(
+            s["value"] for s in counter.snapshot()["samples"]
+        )
+
+    saved = {
+        k: os.environ.get(k)
+        for k in (
+            "OSIM_WAVE_COMMIT", "OSIM_WAVE_SIZE", "OSIM_WAVE_ROUNDS",
+            "OSIM_COMMIT_CHUNK",
+        )
+    }
+
+    def _put_env(key, val):
+        if val is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = val
+
+    def serial_run(ns, carry, batch, w_s, valid_s, s_pad):
+        carry_s = state_mod.stack_carry(carry, s_pad)
+        t0 = time.time()
+        out = fast.schedule_scenarios_host(
+            ns, carry_s, batch, w_s, valid_s, 1
+        )
+        jax.block_until_ready(out[0])
+        return time.time() - t0, out
+
+    def hist_stats(hist):
+        snap = hist.snapshot()
+        if not snap["samples"]:
+            return 0, 0.0
+        s = snap["samples"][0]
+        return int(s["count"]), float(s["sum"])
+
+    out = {}
+    try:
+        os.environ.pop("OSIM_COMMIT_CHUNK", None)
+        os.environ["OSIM_WAVE_COMMIT"] = "0"
+
+        # --- leg 1: the serial oracle at n_pods --------------------------
+        ns, carry, batch = build_state(n_nodes, n_pods)
+        s_pad = fast.scenario_bucket(1)
+        w_s = jnp.asarray(np.stack([np.asarray(weights_array())] * s_pad))
+        valid_s = jnp.asarray(np.stack([np.asarray(ns.valid)] * s_pad))
+        serial_run(ns, carry, batch, w_s, valid_s, s_pad)  # compile
+        t_serial, ref = serial_run(ns, carry, batch, w_s, valid_s, s_pad)
+        ref_digest = fast.scenario_carry_digest(ref[0])
+        p_pad = int(batch.p)
+        nodes_ref = np.asarray(ref[1])
+
+        # --- leg 2: the commit phase (row-wise replay of the choices) ----
+        rows = fast.pod_rows_from_batch(batch)
+        choices = jnp.asarray(
+            np.broadcast_to(nodes_ref[:1], (s_pad, p_pad)).copy()
+        )
+        count = jnp.int32(p_pad)
+
+        def commit_run():
+            carry_s = state_mod.stack_carry(carry, s_pad)
+            t0 = time.time()
+            r = fast.commit_choices(ns, carry_s, rows, valid_s, choices, count)
+            jax.block_until_ready(r[0])
+            return time.time() - t0, r
+
+        commit_run()  # compile
+        t_commit, rep = commit_run()
+        commit_digest = fast.scenario_carry_digest(rep[0])
+        commit_speedup = t_serial / t_commit if t_commit > 0 else None
+
+        # --- leg 3: the wave round driver at wave_pods -------------------
+        ns_w, carry_w, batch_w = build_state(n_nodes, wave_pods)
+        valid_w = jnp.asarray(np.stack([np.asarray(ns_w.valid)] * s_pad))
+        serial_run(ns_w, carry_w, batch_w, w_s, valid_w, s_pad)  # compile
+        t_sw, ref_w = serial_run(ns_w, carry_w, batch_w, w_s, valid_w, s_pad)
+        ref_w_digest = fast.scenario_carry_digest(ref_w[0])
+
+        os.environ["OSIM_WAVE_COMMIT"] = "1"
+        os.environ["OSIM_WAVE_SIZE"] = str(wave)
+        os.environ["OSIM_WAVE_ROUNDS"] = str(wave_rounds)
+        serial_run(ns_w, carry_w, batch_w, w_s, valid_w, s_pad)  # compile
+        rounds_n0, rounds_s0 = hist_stats(metrics.COMMIT_ROUNDS)
+        conflicts0 = msum(metrics.WAVE_CONFLICTS)
+        fallbacks0 = msum(metrics.WAVE_FALLBACKS)
+        t_wave, wout = serial_run(ns_w, carry_w, batch_w, w_s, valid_w, s_pad)
+        wave_digest = fast.scenario_carry_digest(wout[0])
+        rounds_n1, rounds_s1 = hist_stats(metrics.COMMIT_ROUNDS)
+        n_waves = rounds_n1 - rounds_n0
+        rounds_total = rounds_s1 - rounds_s0
+
+        out = {
+            "wall_s": round(t_serial + t_commit + t_sw + t_wave, 2),
+            "value": round(n_pods / t_commit, 1) if t_commit > 0 else None,
+            "unit": "pods/s (commit phase)",
+            "serial_wall_s": round(t_serial, 2),
+            "serial_pods_s": round(n_pods / t_serial, 1),
+            "commit_wall_s": round(t_commit, 3),
+            "commit_phase_speedup_x": (
+                round(commit_speedup, 1) if commit_speedup else None
+            ),
+            "wave_pods": wave_pods,
+            "wave_size": wave,
+            "wave_rounds_budget": wave_rounds,
+            "wave_wall_s": round(t_wave, 2),
+            "wave_serial_wall_s": round(t_sw, 2),
+            "wave_total_speedup_x": (
+                round(t_sw / t_wave, 2) if t_wave > 0 else None
+            ),
+            "waves_dispatched": n_waves,
+            "rounds_to_converge_mean": (
+                round(rounds_total / n_waves, 1) if n_waves else None
+            ),
+            "wave_conflicts": int(msum(metrics.WAVE_CONFLICTS) - conflicts0),
+            "wave_fallbacks": int(msum(metrics.WAVE_FALLBACKS) - fallbacks0),
+            "digest": f"{ref_digest:08x}",
+        }
+        if commit_digest != ref_digest:
+            out["error"] = (
+                f"commit-phase digest {commit_digest:08x} != serial "
+                f"{ref_digest:08x}; the row-wise commit must be "
+                "byte-identical"
+            )
+        elif wave_digest != ref_w_digest:
+            out["error"] = (
+                f"wave-engine digest {wave_digest:08x} != serial "
+                f"{ref_w_digest:08x}; the fixpoint driver must be "
+                "byte-identical"
+            )
+        elif commit_speedup is not None and commit_speedup < 10:
+            out["error"] = (
+                f"commit-phase speedup {commit_speedup:.1f}x below the "
+                "10x acceptance floor"
+            )
+    finally:
+        for k, v in saved.items():
+            _put_env(k, v)
+    return out
+
+
 CONFIGS = {
     "stock": config_stock,
     "fit_1k_100n": config_fit,
@@ -1871,6 +2052,7 @@ CONFIGS = {
     "plan_200k_20k": config_plan_200k_20k,
     "plan_1m_100k": config_plan_1m_100k,
     "checkpoint_overhead": config_checkpoint_overhead,
+    "wave_commit_10k": config_wave_commit_10k,
 }
 
 # Excluded from `--configs all`: run them by name (CI runs plan_200k_20k
@@ -1994,6 +2176,9 @@ SEGMENT_TIMEOUT_S = {
     "serving_concurrent": 600.0,
     "serving_saturation": 900.0,
     "resident_delta_10k": 900.0,
+    # Three legs (serial oracle scan, replayed row-wise commit phase, wave
+    # driver) plus compiles; ~1 min warm on a 1-core CPU host.
+    "wave_commit_10k": 900.0,
     # The scaled plan segments run the default batched sweep, which commits
     # per-pod (no group fast path inside schedule_scenarios yet): on a CPU
     # host they are wall-hours, which is why they sit in SLOW_CONFIGS and

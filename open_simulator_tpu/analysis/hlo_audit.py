@@ -69,7 +69,11 @@ N_CANON = 64
 #: Entries whose scenario lanes are independent by construction: on a
 #: scenario-only mesh (node axis = 1 device) their programs must contain
 #: ZERO collectives — any cross-device op is an accidental dependency.
-LANE_PARALLEL = frozenset({"ops.fast:schedule_scenarios"})
+LANE_PARALLEL = frozenset({
+    "ops.fast:schedule_scenarios",
+    "ops.fast:schedule_wave",
+    "ops.fast:commit_choices",
+})
 
 #: Entries that index nodes by *global id* (dynamic_slice over the node
 #: axis inside their scan loop): node-sharding them forces GSPMD to
@@ -91,7 +95,10 @@ SCENARIO_ONLY = frozenset({"ops.fast:light_scan"})
 #: a single device with no resharding, and every other (rung, mesh) combo
 #: is skipped *visibly* (``programs_skipped``) — a shape contract, not a
 #: suppression.
-FIXED_SHAPE = frozenset({"ops.fast:schedule_universes"})
+FIXED_SHAPE = frozenset({
+    "ops.fast:schedule_universes",
+    "ops.fast:schedule_universes_wave",
+})
 
 DEFAULT_RUNGS: Tuple[int, ...] = (64, 128)
 DEFAULT_MESHES: Tuple[str, ...] = ("1", "2x1", "2x2")

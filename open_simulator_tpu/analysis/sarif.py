@@ -84,8 +84,13 @@ def _result(
     return res
 
 
-def _run(name: str, rule_ids: List[str], results: List[dict]) -> dict:
-    return {
+def _run(
+    name: str,
+    rule_ids: List[str],
+    results: List[dict],
+    properties: Optional[dict] = None,
+) -> dict:
+    run: dict = {
         "tool": {
             "driver": {
                 "name": name,
@@ -97,6 +102,9 @@ def _run(name: str, rule_ids: List[str], results: List[dict]) -> dict:
         "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
         "results": results,
     }
+    if properties:
+        run["properties"] = properties
+    return run
 
 
 # ---------------------------------------------------------------------------
@@ -221,7 +229,15 @@ def preflight_run(report) -> dict:
                 path=anchor,
             )
         )
-    return _run("simon-preflight", rule_ids, results)
+    # the audited inventory rides in the run's property bag: a clean run
+    # then still NAMES every covered program (the wave-commit entries
+    # included), so a regression that drops an entry from the budget book
+    # is visible as an inventory diff, not just an absent annotation
+    covered = sorted({p.key for p in report.programs})
+    return _run(
+        "simon-preflight", rule_ids, results,
+        properties={"programs": covered},
+    )
 
 
 def interleave_run(report) -> dict:

@@ -411,13 +411,22 @@ def _mutated_engine(mode: str):
 
 
 def _dispatch(scope: SmallScope, chunk: Sequence[Universe], s_pad: int,
-              mutate: Optional[str]):
+              mutate: Optional[str], engine: str = "serial"):
     import jax
 
     from ..ops import fast
 
     ns_s, carry_s, pods_s, weights_s = _pack_chunk(scope, chunk, s_pad)
-    fn = fast.schedule_universes if mutate is None else _mutated_engine(mutate)
+    if mutate is not None:
+        # mutation screening always targets the serial oracle engine: the
+        # mutations are authored against schedule_step's expression tree,
+        # and the point is to prove the CHECKER catches them, not to
+        # exercise the wave driver's fallback.
+        fn = _mutated_engine(mutate)
+    elif engine == "wave":
+        fn = fast.schedule_universes_wave_host
+    else:
+        fn = fast.schedule_universes
     carry_out, nodes, reasons, gpu_take, _vt, _dt = fn(
         ns_s, carry_s, pods_s, weights_s
     )
@@ -455,6 +464,7 @@ class ProveReport:
     divergence_total: int = 0
     digest: str = ""
     mutate: Optional[str] = None
+    engine: str = "serial"
     contract_path: Optional[str] = None
     contract_ok: Optional[bool] = None   # None = not verified (smoke/write)
     contract_messages: List[str] = dataclasses.field(default_factory=list)
@@ -473,6 +483,7 @@ class ProveReport:
             "divergence_samples": [d.to_dict() for d in self.divergences],
             "digest": self.digest,
             "mutate": self.mutate,
+            "engine": self.engine,
             "contract": {
                 "path": self.contract_path,
                 "ok": self.contract_ok,
@@ -484,6 +495,7 @@ class ProveReport:
     def render_text(self) -> str:
         lines = [
             f"universes checked : {self.universes_checked}",
+            f"engine            : {self.engine}",
             f"device calls      : {self.device_calls}",
             f"divergences       : {self.divergence_total}",
             f"placement digest  : {self.digest}",
@@ -544,11 +556,15 @@ def check_universes(
     mutate: Optional[str] = None,
     max_samples: int = 8,
     progress=None,
+    engine: str = "serial",
 ) -> ProveReport:
     """Run the engine over `universes` (a handful of identically-shaped
     device calls), diff every lane against the oracle, and fold the
-    canonical placement digest."""
-    report = ProveReport(mutate=mutate)
+    canonical placement digest. `engine`: "serial" dispatches
+    ops.fast:schedule_universes, "wave" drives the conflict-parallel
+    wave engine (ops/wave.py) to its fixpoint — the digest must come out
+    identical either way (the reordered engine's admission proof)."""
+    report = ProveReport(mutate=mutate, engine=engine)
     h = hashlib.sha256()
     s_pad = max(8, min(chunk, ((len(universes) + 7) // 8) * 8))
     # Oracle runs depend only on (node slots, presented pod rows); the
@@ -558,7 +574,7 @@ def check_universes(
     for lo in range(0, len(universes), s_pad):
         batch = universes[lo:lo + s_pad]
         carry_host, nodes, reasons, takes = _dispatch(
-            scope, batch, s_pad, mutate
+            scope, batch, s_pad, mutate, engine
         )
         for j, u in enumerate(batch):
             lane_carry = {f: a[j] for f, a in carry_host.items()}
@@ -592,13 +608,15 @@ def check_universes(
 # ---------------------------------------------------------------------------
 
 def _diverges(scope: SmallScope, u: Universe,
-              mutate: Optional[str]) -> bool:
-    rep = check_universes(scope, [u], chunk=8, mutate=mutate, max_samples=0)
+              mutate: Optional[str], engine: str = "serial") -> bool:
+    rep = check_universes(scope, [u], chunk=8, mutate=mutate, max_samples=0,
+                          engine=engine)
     return rep.divergence_total > 0
 
 
 def minimize(scope: SmallScope, u: Universe,
-             mutate: Optional[str] = None) -> Universe:
+             mutate: Optional[str] = None,
+             engine: str = "serial") -> Universe:
     """Greedily shrink a diverging universe: drop pod slots, then blank node
     slots, keeping divergence at every step (ddmin-style one-at-a-time)."""
     changed = True
@@ -608,14 +626,14 @@ def minimize(scope: SmallScope, u: Universe,
             if len(u.pods) <= 1:
                 break
             cand = Universe(u.nodes, u.pods[:i] + u.pods[i + 1:])
-            if _diverges(scope, cand, mutate):
+            if _diverges(scope, cand, mutate, engine):
                 u, changed = cand, True
                 break
         for i in range(len(u.nodes)):
             if u.nodes[i] == "-":
                 continue
             cand = Universe(u.nodes[:i] + "-" + u.nodes[i + 1:], u.pods)
-            if _diverges(scope, cand, mutate):
+            if _diverges(scope, cand, mutate, engine):
                 u, changed = cand, True
                 break
     return u
@@ -713,6 +731,7 @@ def run_prove(
     chunk: int = DEFAULT_CHUNK,
     mutate: Optional[str] = None,
     progress=None,
+    engine: str = "serial",
 ) -> ProveReport:
     """The `simon prove` entry point.
 
@@ -721,6 +740,12 @@ def run_prove(
     sample spans the corpus) only diff engine vs oracle; the digest is
     sample-dependent, so no contract check. Any divergence triggers the
     counterexample minimizer.
+
+    engine="wave" runs the whole corpus through the conflict-parallel
+    wave engine instead of the serial scan; the contract digest is
+    engine-independent by design, so a full wave run must verify against
+    the SAME banked artifact — that passing run is the wave engine's
+    admission proof under the commit-order contract.
     """
     scope = SmallScope()
     corpus = scope.universes()
@@ -728,7 +753,8 @@ def run_prove(
         stride = max(1, len(corpus) // max(smoke, 1))
         corpus = corpus[::stride][:smoke]
     report = check_universes(
-        scope, corpus, chunk=chunk, mutate=mutate, progress=progress
+        scope, corpus, chunk=chunk, mutate=mutate, progress=progress,
+        engine=engine,
     )
     report.contract_path = contract_path
     if smoke is None and not mutate:
@@ -752,6 +778,6 @@ def run_prove(
         first = report.divergences[0].universe
         nodes, pods = first.split("/")
         report.minimized = minimize(
-            scope, Universe(nodes, pods), mutate
+            scope, Universe(nodes, pods), mutate, engine
         ).key
     return report

@@ -678,6 +678,12 @@ def _run_chaos_capacity(args, plan) -> int:
 
     chunk = _os.environ.get("OSIM_COMMIT_CHUNK", "").strip() or "8"
     every = _os.environ.get("OSIM_CKPT_EVERY", "").strip() or "2"
+    # the device-loss leg runs against the wave engine by default (the
+    # new hot path): one wave per chunk record, rollback to the last
+    # good wave, and the resumed digest still byte-matches the clean
+    # reference. OSIM_WAVE_COMMIT=0 in the environment keeps the serial
+    # chunked driver for comparison.
+    wave = _os.environ.get("OSIM_WAVE_COMMIT", "").strip() or "1"
     metrics.REGISTRY.reset()
     reset_breakers()
 
@@ -685,10 +691,11 @@ def _run_chaos_capacity(args, plan) -> int:
     cleanup = not args.run_dir
     saved = {
         k: _os.environ.get(k)
-        for k in ("OSIM_COMMIT_CHUNK", "OSIM_CKPT_EVERY")
+        for k in ("OSIM_COMMIT_CHUNK", "OSIM_CKPT_EVERY", "OSIM_WAVE_COMMIT")
     }
     _os.environ["OSIM_COMMIT_CHUNK"] = chunk
     _os.environ["OSIM_CKPT_EVERY"] = every
+    _os.environ["OSIM_WAVE_COMMIT"] = wave
     try:
         try:
             cfg = SimonConfig.load(args.simon_config)
@@ -789,7 +796,8 @@ def _run_chaos_capacity(args, plan) -> int:
             )
         lines.append(
             "scenario: chunked capacity sweep "
-            f"(OSIM_COMMIT_CHUNK={chunk}, snapshot every {every} chunk(s))"
+            f"(OSIM_COMMIT_CHUNK={chunk}, snapshot every {every} chunk(s), "
+            f"engine={'wave' if wave != '0' else 'serial'})"
         )
         lines.append("degraded:")
         lines.append(
@@ -1307,6 +1315,14 @@ def _add_prove(sub: argparse._SubParsersAction) -> None:
         "engine variant; the checker must exit nonzero with a minimized "
         "counterexample (proves the prover)",
     )
+    p.add_argument(
+        "--engine", choices=("serial", "wave"), default="serial",
+        help="scheduling engine to prove: the serial scan "
+        "(ops.fast:schedule_universes, default) or the conflict-parallel "
+        "wave engine (ops/wave.py) — both must reproduce the SAME banked "
+        "placement digest; a passing wave run is its admission proof "
+        "under the commit-order contract",
+    )
 
 
 def _run_prove(args) -> int:
@@ -1320,6 +1336,7 @@ def _run_prove(args) -> int:
         smoke=args.smoke,
         chunk=args.chunk or semantics.DEFAULT_CHUNK,
         mutate=args.mutate,
+        engine=args.engine,
         progress=(
             (lambda done, total: print(
                 f"prove: {done}/{total} universes", file=sys.stderr

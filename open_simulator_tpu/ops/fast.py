@@ -68,6 +68,7 @@ from .kernels import (
     _domain_counts,
     _minmax_normalize,
     combine_scores,
+    commit_choice,
     gpu_allocate_rowwise,
     gpu_mask,
     gpu_share_raw,
@@ -79,6 +80,7 @@ from .kernels import (
 )
 from .sanitize import sanitizable
 from . import delta as _delta
+from . import wave as _wave
 from .state import pod_rows_from_batch
 from ..utils import metrics as _metrics
 
@@ -1995,6 +1997,160 @@ def schedule_universes(
     return jax.vmap(one)(ns_s, carry_s, pods_s, weights_s)
 
 
+# ---------------------------------------------------------------------------
+# Conflict-parallel wave commit (ops/wave.py; ROADMAP item 1)
+# ---------------------------------------------------------------------------
+
+@sanitizable("ops.fast:schedule_wave")
+@jax.jit
+def schedule_wave(
+    ns: NodeStatic,
+    carry_s: Carry,
+    pods: PodRow,
+    weights_s: jnp.ndarray,
+    valid_s: jnp.ndarray,
+    choices_s: jnp.ndarray,
+    count: jnp.ndarray,
+    filter_on=None,
+):
+    """One conflict-parallel commit round over a wave of W pods, vmapped
+    over scenario lanes (schedule_scenarios' axis discipline: shared `ns`
+    and pod wave, per-lane valid/carry/weights — plus the per-lane round
+    state `choices_s` i32[S,W]).
+
+    The round body is ops/wave.py's Jacobi step: replay the previous
+    round's choices through the exact commit arithmetic (cheap scan,
+    count-gated like schedule_scenarios_chunked), then re-decide all W
+    pods at their own prefix carries in one data-parallel probe — the
+    heavy ~dozen-plugin sweep runs W-wide instead of once per scan step.
+    On the converged round (returned choices == `choices_s`) every output
+    is byte-identical to the serial scan over the same wave.
+
+    `carry_s` is NOT donated: the wave-input carry is re-read by every
+    round until the host driver observes the fixpoint and adopts the exit
+    carry. Returns (carry_s, choices i32[S,W], reasons i32[S,W,F],
+    gpu_take i32[S,W,G], vg_take f32[S,W,V], dev_take f32[S,W,DV]).
+    """
+
+    def one(valid, carry, weights, choices):
+        return _wave.wave_round(
+            ns._replace(valid=valid), weights, carry, pods, choices,
+            count, filter_on,
+        )
+
+    return jax.vmap(one)(valid_s, carry_s, weights_s, choices_s)
+
+
+@sanitizable("ops.fast:schedule_universes_wave")
+@jax.jit
+def schedule_universes_wave(
+    ns_s: NodeStatic,
+    carry_s: Carry,
+    pods_s: PodRow,
+    weights_s: jnp.ndarray,
+    choices_s: jnp.ndarray,
+    filter_on=None,
+):
+    """schedule_universes' axis (EVERY leaf stacked per lane) under the
+    wave round body: one Jacobi round for S whole universes at once, the
+    whole pod sequence as a single wave. `simon prove --engine wave`
+    drives this to a fixpoint per chunk and must reproduce the banked
+    placement digest bit-for-bit — the reordered engine's admission
+    proof. No count gate (every presented pod row is live) and no carry
+    donation (rounds re-read the chunk-input carry)."""
+
+    def one(ns, carry, pods, weights, choices):
+        return _wave.wave_round(
+            ns, weights, carry, pods, choices, None, filter_on
+        )
+
+    return jax.vmap(one)(ns_s, carry_s, pods_s, weights_s, choices_s)
+
+
+@sanitizable("ops.fast:commit_choices")
+@jax.jit
+def commit_choices(
+    ns: NodeStatic,
+    carry_s: Carry,
+    pods: PodRow,
+    valid_s: jnp.ndarray,
+    choices_s: jnp.ndarray,
+    count: jnp.ndarray,
+):
+    """The wave engine's COMMIT PHASE in isolation: replay decided
+    choices (i32[S,W], -1 = no commit) through `kernels.commit_choice` —
+    the row-wise O(row) commit — with no probe and no prefix-carry
+    stacking. This is the only part of the wave engine that is
+    inherently sequential (each commit reads the previous commit's
+    carry), so its wall time is the engine's sequential depth; the
+    `wave_commit_10k` bench gates it at ≥10× faster than the serial
+    decide+commit scan. Byte-identical to replaying the same choices
+    through the serial scan (see commit_choice's bit-identity note).
+
+    Returns (carry_s, gpu_take i32[S,W,G], vg_take f32[S,W,V],
+    dev_take f32[S,W,DV]). `carry_s` is not donated (callers may retry
+    a wave after a fault injection)."""
+    w = int(jax.tree_util.tree_leaves(pods)[0].shape[0])
+    idx = jnp.arange(w, dtype=jnp.int32)
+
+    def one(valid, carry, choices):
+        ns_l = ns._replace(valid=valid)
+        gated = jnp.where(idx < count, choices, jnp.int32(-1))
+
+        def step(c, xs):
+            pod, choice = xs
+            c2, gpu_take, vg_take, dev_take = commit_choice(
+                ns_l, c, pod, choice
+            )
+            return c2, (gpu_take.astype(jnp.int32), vg_take, dev_take)
+
+        final, takes = jax.lax.scan(step, carry, (pods, gated))
+        return (final,) + takes
+
+    return jax.vmap(one)(valid_s, carry_s, choices_s)
+
+
+def schedule_universes_wave_host(
+    ns_s: NodeStatic,
+    carry_s: Carry,
+    pods_s: PodRow,
+    weights_s: jnp.ndarray,
+    filter_on=None,
+):
+    """Drive schedule_universes_wave to its fixpoint: same signature and
+    return tuple as schedule_universes (which donates its carry; this
+    driver instead keeps the input carry alive across rounds and returns
+    the converged round's exit carry). Guaranteed to converge within W+1
+    rounds (ops/wave.py); the impossible-overrun guard falls back to the
+    serial oracle rather than looping."""
+    s_pad = int(jax.tree_util.tree_leaves(carry_s)[0].shape[0])
+    p_pad = int(jax.tree_util.tree_leaves(pods_s)[0].shape[1])
+    choices = jnp.full((s_pad, p_pad), -1, jnp.int32)
+    prev = np.full((s_pad, p_pad), -1, np.int32)
+    rounds = 0
+    while True:
+        rounds += 1
+        _progress(f"universes-wave S={s_pad} P={p_pad} round {rounds}")
+        carry_w, choices_new, reasons, gpu_take, vg_take, dev_take = (
+            schedule_universes_wave(
+                ns_s, carry_s, pods_s, weights_s, choices, filter_on
+            )
+        )
+        ch = np.asarray(jax.device_get(choices_new))
+        if np.array_equal(ch, prev):
+            break
+        if rounds > 1:
+            _metrics.WAVE_CONFLICTS.inc(int((ch != prev).sum()))
+        if rounds > p_pad + 1:
+            _metrics.WAVE_FALLBACKS.inc(reason="universes_max_rounds")
+            return schedule_universes(
+                ns_s, carry_s, pods_s, weights_s, filter_on
+            )
+        choices, prev = choices_new, ch
+    _metrics.COMMIT_ROUNDS.observe(rounds)
+    return carry_w, choices_new, reasons, gpu_take, vg_take, dev_take
+
+
 def schedule_scenarios_host(
     ns: NodeStatic,
     carry_s: Carry,
@@ -2022,12 +2178,24 @@ def schedule_scenarios_host(
     schedule_scenarios_chunked calls whose chained result is byte-identical
     to the single scan, with a checkpoint hook between chunks
     (durable/checkpoint.py) and device-fault recovery — see
-    docs/durability.md."""
+    docs/durability.md.
+
+    With the wave engine enabled (OSIM_WAVE_COMMIT / auto above
+    ops.wave.WAVE_AUTO_MIN_PODS pods) the dispatch is the
+    conflict-parallel wave driver instead — byte-identical to the serial
+    scan by fixpoint construction (docs/performance.md), checkpointing
+    one wave per `plan_chunk` record with the same digest chain a serial
+    chunked run of chunk = wave size would journal."""
     rows = pod_rows_from_batch(batch)
     s_pad = int(valid_s.shape[0])
     key = (int(ns.valid.shape[0]), int(batch.p))
     _SCENARIO_PROGRAMS.setdefault(key, set()).add(s_pad)
     _metrics.SCENARIOS_PER_CALL.observe(s_real)
+    if _wave.wave_enabled(int(batch.p)):
+        return _schedule_scenarios_wave_host(
+            ns, carry_s, rows, weights_s, valid_s, s_real, s_pad,
+            int(batch.p), _wave.wave_size(), filter_on,
+        )
     chunk = commit_chunk_size()
     if chunk and int(batch.p) > chunk:
         return _schedule_scenarios_chunked_host(
@@ -2156,6 +2324,179 @@ def _schedule_scenarios_chunked_host(
         )
         got = jax.device_get((nodes, reasons, gpu_take, vg_take, dev_take))
         outs.append(tuple(np.asarray(a)[:, :count] for a in got))
+        _metrics.PLAN_CHUNKS.inc()
+        if cp is not None:
+            digest = scenario_carry_digest(carry_s)
+            hostc = cp.on_chunk(plan, i, lo + count, digest, carry_s, outs)
+            if hostc is not None:
+                last_good = (i + 1, hostc, len(outs), digest)
+        i += 1
+
+    if cp is not None:
+        cp.finish_plan(plan, scenario_carry_digest(carry_s))
+    cat = tuple(
+        np.concatenate([o[k] for o in outs], axis=1) for k in range(5)
+    )
+    return (carry_s,) + tuple(a[:s_real] for a in cat)
+
+
+def _schedule_scenarios_wave_host(
+    ns: NodeStatic,
+    carry_s: Carry,
+    rows: PodRow,
+    weights_s: jnp.ndarray,
+    valid_s: jnp.ndarray,
+    s_real: int,
+    s_pad: int,
+    p_real: int,
+    wave: int,
+    filter_on=None,
+):
+    """The outer host loop of the conflict-parallel wave commit driver.
+
+    Structure is _schedule_scenarios_chunked_host's with one wave per
+    chunk slot: per wave, iterate schedule_wave rounds until the probe
+    reproduces its own input choices (the fixpoint — byte-identical to
+    the serial scan, ops/wave.py), then adopt that round's exit carry
+    and outputs. A wave that exhausts OSIM_WAVE_ROUNDS is re-run through
+    the serial chunked kernel (the oracle path; counted in
+    osim_wave_fallbacks_total) so the driver is never slower than
+    serial + the round budget, and never wrong.
+
+    Durability and fault handling are inherited wholesale: one
+    `plan_chunk` journal record per committed wave with the same
+    scenario-carry digest chain a serial chunked run (C = wave) would
+    write — so a wave plan resumes from a serial run's snapshot and vice
+    versa — and device-loss rolls back to the last good committed wave
+    (in-flight rounds are discarded; rounds mutate nothing until the
+    fixpoint is adopted)."""
+    from ..durable import checkpoint as _checkpoint
+    from ..resilience import faults as _faults
+    from ..utils import flightrec as _flightrec
+
+    N = int(ns.valid.shape[0])
+    n_waves = -(-p_real // wave)
+    p_pad = n_waves * wave
+    if p_pad != p_real:
+        rows = jax.tree_util.tree_map(
+            lambda a: jnp.concatenate(
+                [a, jnp.broadcast_to(a[:1], (p_pad - p_real,) + a.shape[1:])]
+            ),
+            rows,
+        )
+    _SCENARIO_PROGRAMS.setdefault((N, wave), set()).add(s_pad)
+    max_rounds = _wave.wave_max_rounds()
+
+    cp = _checkpoint.active_checkpointer()
+    plan = None
+    start_wave = 0
+    outs: list = []  # host (nodes, reasons, gpu, vg, dev) tuples, in order
+    if cp is not None:
+        plan = cp.begin_plan(
+            n_nodes=N, p_real=p_real, s_pad=s_pad, chunk=wave,
+            n_chunks=n_waves,
+        )
+        restore = plan.restore
+        if restore is not None:
+            start_wave = restore.chunks_done
+            carry_s = carry_from_host(carry_s, restore.carry)
+            outs.append(restore.outputs)
+            _metrics.RESUME_CHUNKS_SKIPPED.inc(start_wave)
+            _flightrec.note(
+                "plan-restore", plan=plan.key, chunk=start_wave - 1,
+                digest=f"{restore.digest:08x}",
+            )
+            _flightrec.dump("chunk-restore", run_dir=cp.run_dir)
+
+    track = cp is not None or _faults.has_rules("device")
+    last_good = None  # (wave_idx, host carry leaves, len(outs), digest)
+    if track:
+        host0 = carry_to_host(carry_s)
+        last_good = (
+            start_wave, host0, len(outs), scenario_carry_digest_host(host0),
+        )
+    strikes = 0
+
+    i = start_wave
+    while i < n_waves:
+        rule = _faults.maybe_inject("device", f"commit-chunk:{i}")
+        if rule is not None:
+            try:
+                _faults.apply_device_fault(rule)
+            except _faults.DeviceLostError:
+                strikes += 1
+                if last_good is None or strikes >= 3:
+                    _metrics.DEVICE_LOST.inc(handled="no")
+                    raise
+                _metrics.DEVICE_LOST.inc(handled="yes")
+                g_wave, g_carry, g_outs, g_digest = last_good
+                _flightrec.note(
+                    "device-lost", chunk=i, restored_to=g_wave,
+                    digest=f"{g_digest:08x}",
+                )
+                _flightrec.dump(
+                    "device-lost",
+                    run_dir=cp.run_dir if cp is not None else None,
+                )
+                carry_s = carry_from_host(carry_s, g_carry)
+                del outs[g_outs:]
+                i = g_wave
+                continue
+        lo = i * wave
+        count = min(wave, p_real - lo)
+        rows_w = jax.tree_util.tree_map(lambda a: a[lo:lo + wave], rows)
+        choices = jnp.full((s_pad, wave), -1, jnp.int32)
+        prev = np.full((s_pad, wave), -1, np.int32)
+        rounds = 0
+        converged = False
+        while True:
+            rounds += 1
+            _progress(
+                f"scenarios S={s_real}/{s_pad} N={N} "
+                f"wave {i + 1}/{n_waves} round {rounds} "
+                f"(W={wave}, live={count})"
+            )
+            carry_w, choices_new, reasons, gpu_take, vg_take, dev_take = (
+                schedule_wave(
+                    ns, carry_s, rows_w, weights_s, valid_s, choices,
+                    jnp.int32(count), filter_on,
+                )
+            )
+            ch = np.asarray(jax.device_get(choices_new))
+            if np.array_equal(ch, prev):
+                converged = True
+                break
+            if rounds > 1:
+                _metrics.WAVE_CONFLICTS.inc(
+                    int((ch[:s_real, :count] != prev[:s_real, :count]).sum())
+                )
+            if max_rounds and rounds >= max_rounds:
+                break
+            choices, prev = choices_new, ch
+        _metrics.COMMIT_ROUNDS.observe(rounds)
+        if converged:
+            carry_s = carry_w
+            got = jax.device_get((reasons, gpu_take, vg_take, dev_take))
+            outs.append(
+                (np.ascontiguousarray(ch[:, :count]),)
+                + tuple(np.asarray(a)[:, :count] for a in got)
+            )
+        else:
+            _metrics.WAVE_FALLBACKS.inc(reason="max_rounds")
+            _progress(
+                f"wave {i + 1}/{n_waves}: no fixpoint in {rounds} rounds; "
+                "replaying through the serial chunk kernel"
+            )
+            carry_s, nodes, reasons, gpu_take, vg_take, dev_take = (
+                schedule_scenarios_chunked(
+                    ns, carry_s, rows_w, weights_s, valid_s,
+                    jnp.int32(count), filter_on,
+                )
+            )
+            got = jax.device_get(
+                (nodes, reasons, gpu_take, vg_take, dev_take)
+            )
+            outs.append(tuple(np.asarray(a)[:, :count] for a in got))
         _metrics.PLAN_CHUNKS.inc()
         if cp is not None:
             digest = scenario_carry_digest(carry_s)

@@ -1031,6 +1031,163 @@ def commit_onehot(ns: NodeStatic, carry: Carry, pod: PodRow, onehot):
     return new_carry, gpu_take, vg_take, dev_take
 
 
+def _gpu_allocate_row(free_d, total_d, pod: PodRow):
+    """gpu_allocate's take for ONE node row (free_d f32[G], total_d f32[G]).
+    Bit-identical to gpu_allocate's einsum-projected result for that row:
+    the projection is one 1.0 times f32 values plus exact +0.0 terms, and
+    every op here is the dense op applied to the extracted row (the
+    gpu_allocate_rowwise argument, one row at a time)."""
+    mem = pod.gpu_mem
+    g = free_d.shape[0]
+
+    elig = (total_d > 0) & (free_d >= mem - _EPS)
+    tight = jnp.argmin(jnp.where(elig, free_d, jnp.inf))
+    take_single = (
+        (jnp.arange(g) == tight) & jnp.any(elig)
+    ).astype(jnp.float32)
+
+    caps = jnp.where(
+        total_d > 0, jnp.floor((free_d + _EPS) / jnp.maximum(mem, 1e-9)), 0.0
+    )
+    prefix = jnp.cumsum(caps) - caps
+    take_multi = jnp.clip(pod.gpu_num - prefix, 0.0, caps)
+    take_multi = jnp.where(jnp.sum(caps) >= pod.gpu_num, take_multi, 0.0)
+
+    take = jnp.where(pod.gpu_num == 1, take_single, take_multi)
+    return jnp.where((mem > 0) & (pod.gpu_num >= 1), take, 0.0)
+
+
+def _local_storage_take_row(vg_cap, vg_name, dev_cap, dev_ssd,
+                            vg_free, dev_free, pod: PodRow):
+    """local_storage_eval's takes for ONE node row (all args are that
+    node's [V]/[DV] slices). Each slot step is the dense step's arithmetic
+    with the node axis removed — the eval is node-local by construction
+    (every op there maps axis 1 independently per row), so the takes are
+    bit-identical to the dense eval's row."""
+    v = vg_cap.shape[0]
+    dv = dev_cap.shape[0]
+    sv = pod.lvm_req.shape[0]
+
+    def lvm_slot(state, s):
+        free, take = state
+        req = pod.lvm_req[s]
+        active = req > 0
+        want = pod.lvm_vg[s]
+        fits = (free + _EPS >= req) & (vg_name != 0)
+        elig = jnp.where(want != 0, fits & (vg_name == want), fits)
+        free_key = jnp.where(elig, free, jnp.inf)
+        choice = jnp.argmin(free_key)
+        any_elig = jnp.any(elig)
+        onehot = (
+            (jnp.arange(v) == choice) & any_elig & active
+        ).astype(jnp.float32)
+        return (free - onehot * req, take + onehot * req), None
+
+    (_, vg_take), _ = jax.lax.scan(
+        lvm_slot, (vg_free, jnp.zeros_like(vg_free)), jnp.arange(sv)
+    )
+
+    def dev_slot(state, s):
+        avail, take = state
+        req = pod.dev_req[s]
+        active = req > 0
+        elig = (
+            (avail > 0.5)
+            & (dev_ssd == pod.dev_media_ssd[s])
+            & (dev_cap + _EPS >= req)
+            & (dev_cap > 0)
+        )
+        cap_key = jnp.where(elig, dev_cap, jnp.inf)
+        choice = jnp.argmin(cap_key)
+        any_elig = jnp.any(elig)
+        onehot = (
+            (jnp.arange(dv) == choice) & any_elig & active
+        ).astype(jnp.float32)
+        return (avail - onehot, take + onehot), None
+
+    (_, dev_take), _ = jax.lax.scan(
+        dev_slot, (dev_free, jnp.zeros_like(dev_free)), jnp.arange(sv)
+    )
+    return vg_take, dev_take
+
+
+def commit_choice(ns: NodeStatic, carry: Carry, pod: PodRow, choice):
+    """commit_onehot for a known node index (i32 scalar, -1 = no commit),
+    in O(row) work instead of O(N): only the chosen node's row/column of
+    each carry plane changes, so gather that slice, apply the dense
+    commit's row arithmetic, and scatter it back (a -1/invalid choice
+    scatters out of bounds and is dropped — the carry is returned
+    untouched, bitwise, exactly like an all-False onehot).
+
+    Bit-identity to commit_onehot(..., onehot=(arange(N)==choice)&ok):
+    dense planes update as `x - onehot*delta` / `x + delta*onehot` —
+    unchosen entries add or subtract an exact +0.0 (every delta is
+    nonnegative, so no -0.0 products), which is bitwise identity, and the
+    chosen row sees `1.0 * delta` which is bitwise `delta`; the gpu and
+    storage takes follow the gpu_allocate_rowwise row-extraction
+    argument. This is the wave engine's replay step (ops/wave.py) and the
+    commit phase of `ops.fast:commit_choices`; `simon prove` holds it to
+    the banked digest over the full small-scope corpus."""
+    n = ns.valid.shape[0]
+    ok = (choice >= 0) & pod.valid
+    row = jnp.where(ok, choice, 0)        # safe gather index
+    idx = jnp.where(ok, choice, n)        # out-of-bounds scatters drop
+
+    free = carry.free.at[idx].set(
+        carry.free[row] - pod.req, mode="drop"
+    )
+    sel_counts = carry.sel_counts.at[:, idx].set(
+        carry.sel_counts[:, row] + pod.match_sel.astype(jnp.float32),
+        mode="drop",
+    )
+    anti_counts = carry.anti_counts.at[:, idx].set(
+        carry.anti_counts[:, row] + pod.own_anti, mode="drop"
+    )
+
+    gpu_take = jnp.where(
+        ok,
+        _gpu_allocate_row(carry.gpu_free[row], ns.gpu_total[row], pod),
+        jnp.zeros(carry.gpu_free.shape[1], jnp.float32),
+    )
+    gpu_free = carry.gpu_free.at[idx].set(
+        carry.gpu_free[row] - gpu_take * pod.gpu_mem, mode="drop"
+    )
+
+    vg_take_row, dev_take_row = _local_storage_take_row(
+        ns.vg_cap[row], ns.vg_name[row], ns.dev_cap[row], ns.dev_ssd[row],
+        carry.vg_free[row], carry.dev_free[row], pod,
+    )
+    vg_take = jnp.where(ok, vg_take_row, jnp.zeros_like(vg_take_row))
+    dev_take = jnp.where(ok, dev_take_row, jnp.zeros_like(dev_take_row))
+    vg_free = carry.vg_free.at[idx].set(
+        carry.vg_free[row] - vg_take_row, mode="drop"
+    )
+    dev_free = carry.dev_free.at[idx].set(
+        carry.dev_free[row] - dev_take_row, mode="drop"
+    )
+
+    add_any, add_wild, add_ipc = port_adds(
+        carry.port_any.shape[0], carry.port_ipc.shape[0], pod
+    )
+    port_any = carry.port_any.at[:, idx].set(
+        carry.port_any[:, row] + add_any, mode="drop"
+    )
+    port_wild = carry.port_wild.at[:, idx].set(
+        carry.port_wild[:, row] + add_wild, mode="drop"
+    )
+    port_ipc = carry.port_ipc.at[:, idx].set(
+        carry.port_ipc[:, row] + add_ipc, mode="drop"
+    )
+
+    new_carry = Carry(
+        free=free, sel_counts=sel_counts, gpu_free=gpu_free,
+        vg_free=vg_free, dev_free=dev_free,
+        port_any=port_any, port_wild=port_wild, port_ipc=port_ipc,
+        anti_counts=anti_counts,
+    )
+    return new_carry, gpu_take, vg_take, dev_take
+
+
 def schedule_step(
     ns: NodeStatic,
     weights: jnp.ndarray,

@@ -1,0 +1,203 @@
+"""Conflict-parallel wave commit: the round body (ROADMAP item 1).
+
+The serial engines funnel every pod through one `lax.scan` step — N pods
+means N sequential heavy filter/score sweeps, so `plan_200k_20k` is
+wall-hours on CPU and a 1M-pod plan is 1M device steps no matter how many
+chips the mesh has. The wave engine replaces that chain with a
+Jacobi-style fixpoint over a *wave* of W pods:
+
+  round r:
+    1. REPLAY (cheap): scan the round r-1 choices (i32[W], -1 = no
+       commit) through `commit_choice` — the row-wise O(row) form of the
+       serial scan's commit arithmetic, ~1-2% of a schedule_step —
+       emitting each pod's PRE-commit carry, the allocation takes, and
+       the wave's exit carry.
+    2. PROBE (heavy, data-parallel): re-decide every pod at its own
+       prefix carry with the exact `schedule_step` filter/score/argmax/
+       reason formulas, all W pods in one vmapped sweep.
+
+  converged when the probe reproduces its own input choices; that
+  round's replay outputs are then byte-identical to the serial scan.
+
+Why the fixpoint is exact and always terminates: pod 0's prefix carry is
+the wave-input carry in every round, so its choice is correct and stable
+after round 1; inductively pod i's prefix depends only on choices
+0..i-1, so it is correct and stable after round i+1 — at most W+1 rounds
+(realistic waves converge in 2-3: round 1 decides, round 2 confirms).
+Any fixpoint IS the serial solution, so convergence can never mask a
+divergence. A naive "commit all non-colliding argmax winners" auction is
+NOT serial-equivalent — score normalizations are global, several plugins
+are carry-coupled, and two pods may legally pile onto one node — which
+is why the probe re-decides against exact prefix carries instead.
+
+Bit-identity: the replay applies `commit_choice` — bitwise equal to
+`commit_onehot` by the row-extraction argument documented on it — to the
+same (carry, pod, choice) inputs in the same order as the serial scan
+(a -1 choice is a dropped scatter, exactly the all-False-onehot no-op
+schedule_step produces for an unschedulable pod), and the probe is
+schedule_step's own expression sequence, so no float is ever produced
+by a different op sequence.
+`simon prove --contract` replays all 151,875 small-scope universes
+through the wave engine and must reproduce the banked placement digest
+(budgets/commit_contract.json) bit-for-bit — that artifact, not this
+docstring, is the admission proof the commit-order contract demands.
+
+Knobs (all read per call, so tests can flip them):
+  OSIM_WAVE_COMMIT  ""/unset = auto (wave when the plan is large enough
+                    to amortize the rounds), "1" = force on, "0" = off —
+                    the escape hatch back to the serial oracle.
+  OSIM_WAVE_SIZE    pods per wave (default: OSIM_COMMIT_CHUNK if set,
+                    else 256). Following the chunk size keeps the
+                    checkpoint plan key and `plan_chunk` digest chain
+                    identical to a serial chunked run (docs/durability).
+  OSIM_WAVE_ROUNDS  fallback bound: a wave that has not converged after
+                    this many rounds is re-run through the serial
+                    chunked kernel (metric reason="max_rounds"). 0 =
+                    no bound (the W+1 guarantee is the bound).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from .kernels import (
+    NUM_FILTERS,
+    commit_choice,
+    run_filters,
+    run_scores,
+)
+
+# Auto mode enables the wave engine only above this many pods: small
+# plans (tier-1 tests, single-batch simulate calls) stay on the serial
+# scan so they never pay wave compiles, while capacity-scale plans
+# (10k+) get the conflict-parallel path without any opt-in.
+WAVE_AUTO_MIN_PODS = 512
+
+DEFAULT_WAVE_SIZE = 256
+DEFAULT_MAX_ROUNDS = 24
+
+
+def wave_mode() -> str:
+    """'off' | 'on' | 'auto' from OSIM_WAVE_COMMIT."""
+    raw = os.environ.get("OSIM_WAVE_COMMIT", "").strip()
+    if raw == "0":
+        return "off"
+    if raw == "":
+        return "auto"
+    return "on"
+
+
+def wave_size() -> int:
+    """Pods per wave. Defaults to OSIM_COMMIT_CHUNK when chunking is on,
+    so one wave = one checkpoint chunk and the `plan_chunk` digest chain
+    (and the plan key itself) matches a serial chunked run of the same
+    plan — resume interops in both directions."""
+    for var in ("OSIM_WAVE_SIZE", "OSIM_COMMIT_CHUNK"):
+        raw = os.environ.get(var, "").strip()
+        if raw:
+            try:
+                v = int(raw)
+            except ValueError:
+                continue
+            if v > 0:
+                return v
+    return DEFAULT_WAVE_SIZE
+
+
+def wave_max_rounds() -> int:
+    try:
+        return max(
+            0, int(os.environ.get("OSIM_WAVE_ROUNDS", "") or DEFAULT_MAX_ROUNDS)
+        )
+    except ValueError:
+        return DEFAULT_MAX_ROUNDS
+
+
+def _parallel_backend() -> bool:
+    """Auto mode only helps where probes actually run in parallel: an
+    accelerator backend, or a CPU with enough cores that the vmapped
+    probe beats the serial chain on throughput, not just on dispatch
+    count. On a 1-2 core CPU the serial scan is element-throughput-bound
+    and a full-wave probe round costs about as much as serially scanning
+    the whole wave, so auto stays off there (force with
+    OSIM_WAVE_COMMIT=1 — still bit-identical, just not faster)."""
+    try:
+        if jax.default_backend() != "cpu":
+            return True
+    except Exception:
+        pass
+    return (os.cpu_count() or 1) >= 8
+
+
+def wave_enabled(p_real: int) -> bool:
+    """Should schedule_scenarios_host route this plan to the wave driver?"""
+    mode = wave_mode()
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    if not _parallel_backend():
+        return False
+    return int(p_real) >= max(WAVE_AUTO_MIN_PODS, 2 * wave_size())
+
+
+def probe_choice(ns, weights, carry, pod, filter_on=None):
+    """schedule_step minus the commit: decide ONE pod against `carry`
+    exactly as the serial scan would — same mask, same -inf fold, same
+    first-max argmax, same pod.valid gate, same reason histogram.
+    Returns (node i32 scalar, -1 = unschedulable; reasons i32[F])."""
+    mask, first_fail = run_filters(ns, carry, pod, filter_on)
+    score = run_scores(ns, carry, pod, weights)
+    score = jnp.where(mask, score, -jnp.inf)
+    node = jnp.argmax(score)  # first max => lowest node index tie-break
+    ok = jnp.any(mask) & pod.valid
+    node_out = jnp.where(ok, node, -1)
+    reasons = jnp.zeros(NUM_FILTERS, jnp.int32).at[
+        jnp.clip(first_fail, 0, NUM_FILTERS - 1)
+    ].add(jnp.where((first_fail < NUM_FILTERS) & ns.valid, 1, 0))
+    reasons = jnp.where(ok, jnp.zeros_like(reasons), reasons)
+    return node_out.astype(jnp.int32), reasons
+
+
+def wave_round(ns, weights, carry, pods, choices, count, filter_on=None):
+    """One Jacobi round for ONE lane (vmapped by ops/fast.py entries).
+
+    `choices` i32[W] are the previous round's decisions (-1 initially and
+    for no-commit pods). `count` is the live-pod gate (traced i32 scalar;
+    None = every pod live, the universes variant). Returns
+    (exit_carry, new_choices i32[W], reasons i32[W,F],
+     gpu_take i32[W,G], vg_take f32[W,V], dev_take f32[W,DV])
+    where the takes/exit carry replay THIS round's input choices — on the
+    converged round (new_choices == choices) they are the serial scan's
+    outputs bitwise.
+    """
+    w = choices.shape[0]
+    idx = jnp.arange(w, dtype=jnp.int32)
+    if count is not None:
+        # gate dead (pad) steps by pinning their choice to -1: a -1
+        # choice is a dropped scatter inside commit_choice, which leaves
+        # the carry bitwise untouched — the same result as the serial
+        # chunked kernel's per-leaf live gate, with zero extra work.
+        choices = jnp.where(idx < count, choices, jnp.int32(-1))
+
+    def replay(c, xs):
+        pod, choice = xs
+        c2, gpu_take, vg_take, dev_take = commit_choice(ns, c, pod, choice)
+        return c2, (c, gpu_take.astype(jnp.int32), vg_take, dev_take)
+
+    final, (pre, gpu_take, vg_take, dev_take) = jax.lax.scan(
+        replay, carry, (pods, choices)
+    )
+
+    def probe(c, pod):
+        return probe_choice(ns, weights, c, pod, filter_on)
+
+    new_choices, reasons = jax.vmap(probe)(pre, pods)
+    if count is not None:
+        # pad steps pin to -1 so they can never block convergence (their
+        # replay is a no-op commit and their outputs are trimmed anyway)
+        new_choices = jnp.where(idx < count, new_choices, jnp.int32(-1))
+    return final, new_choices, reasons, gpu_take, vg_take, dev_take

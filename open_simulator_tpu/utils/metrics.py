@@ -531,6 +531,27 @@ RESUME_CHUNKS_SKIPPED = REGISTRY.counter(
     "Commit chunks a resumed plan restored from a snapshot instead of "
     "re-executing.",
 )
+COMMIT_ROUNDS = REGISTRY.histogram(
+    "osim_commit_rounds",
+    "Rounds to fixpoint per wave in the conflict-parallel wave commit "
+    "engine (ops/wave.py). 2 is the floor: one round to decide, one to "
+    "confirm; a wave that exhausts its round budget records the budget "
+    "it burned before the serial fallback.",
+    buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32),
+)
+WAVE_CONFLICTS = REGISTRY.counter(
+    "osim_wave_conflicts_total",
+    "Pod decisions revised between wave rounds (choice changes observed "
+    "in rounds >= 2): each count is one pod whose tentative placement was "
+    "disturbed by an earlier pod's commit and re-decided.",
+)
+WAVE_FALLBACKS = REGISTRY.counter(
+    "osim_wave_fallbacks_total",
+    "Waves re-run through the serial chunked kernel after failing to "
+    "reach the fixpoint within the round budget (OSIM_WAVE_ROUNDS), by "
+    "reason. The fallback is the oracle path: results stay byte-identical.",
+    labelnames=("reason",),
+)
 DEVICE_LOST = REGISTRY.counter(
     "osim_device_lost_total",
     "Device-loss events seen by the chunked commit driver; handled=yes "
